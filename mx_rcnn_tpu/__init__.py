@@ -1,0 +1,19 @@
+"""mx_rcnn_tpu — a TPU-native two-stage object-detection framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capabilities of the MXNet
+reference ``mx-rcnn`` (Faster R-CNN with VGG/ResNet backbones on Pascal VOC
+and COCO), designed TPU-first:
+
+- Flax modules + one jitted train step replace the MXNet Symbol graph and
+  its C++ dependency engine (reference: ``rcnn/symbol/*``, MXNet Module).
+- Fixed-shape + validity-mask computation replaces host-side dynamic-shape
+  ``CustomOp`` callbacks (reference: ``rcnn/symbol/proposal.py``,
+  ``rcnn/symbol/proposal_target.py``).
+- Pallas kernels replace the ROIPooling / NMS CUDA operators
+  (reference: ``rcnn/cython/nms_kernel.cu``, MXNet ROIPooling).
+- ``shard_map`` + ``psum`` over a ``jax.sharding.Mesh`` replaces the
+  KVStore('device') single-node multi-GPU trainer
+  (reference: ``train_end2end.py :: train_net``).
+"""
+
+__version__ = "0.1.0"

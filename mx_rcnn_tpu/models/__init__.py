@@ -1,0 +1,5 @@
+from mx_rcnn_tpu.models.resnet import ResNetBackbone, ResNetTopHead
+from mx_rcnn_tpu.models.vgg import VGGBackbone, VGGTopHead
+from mx_rcnn_tpu.models.rpn import RPNHead
+from mx_rcnn_tpu.models.heads import RCNNHead
+from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN

@@ -1,0 +1,71 @@
+"""RCNN classification/regression head (and mask head).
+
+Reference: the ``cls_score``/``bbox_pred`` fully-connected pair appended
+after the fc6-fc7 (VGG) or conv5-pool (ResNet) trunk in
+``rcnn/symbol/symbol_{vgg,resnet}.py``; initialized Normal(0.01)/
+Normal(0.001) respectively (``train_end2end.py :: train_net``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.models.layers import conv
+
+
+class RCNNHead(nn.Module):
+    num_classes: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(R, D) trunk features → cls logits (R, K), box deltas (R, 4K)."""
+        cls_score = nn.Dense(
+            self.num_classes,
+            kernel_init=nn.initializers.normal(0.01),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="cls_score",
+        )(x)
+        bbox_pred = nn.Dense(
+            4 * self.num_classes,
+            kernel_init=nn.initializers.normal(0.001),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="bbox_pred",
+        )(x)
+        return cls_score.astype(jnp.float32), bbox_pred.astype(jnp.float32)
+
+
+class MaskHead(nn.Module):
+    """Mask R-CNN head: 4×conv + deconv ×2 + 1×1 per-class mask logits.
+
+    Extension target (BASELINE config 5); no reference twin — follows the
+    original Mask R-CNN paper head on (R, 14, 14, C) pooled features.
+    """
+
+    num_classes: int
+    channels: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i in range(4):
+            x = conv(self.channels, 3, 1, self.dtype, name=f"mask_conv{i + 1}",
+                     use_bias=True)(x)
+            x = nn.relu(x)
+        x = nn.ConvTranspose(
+            self.channels,
+            (2, 2),
+            strides=(2, 2),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="mask_deconv",
+        )(x)
+        x = nn.relu(x)
+        logits = conv(self.num_classes, 1, 1, self.dtype, name="mask_logits",
+                      use_bias=True)(x)
+        return logits.astype(jnp.float32)

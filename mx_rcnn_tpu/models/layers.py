@@ -1,0 +1,68 @@
+"""Shared NN building blocks.
+
+TPU-first conventions: NHWC layout (XLA's native conv layout on TPU),
+optional bfloat16 compute with float32 parameters (MXU-friendly), and
+*frozen* batch-norm as an affine transform using stored moments —
+the reference runs every BN with ``use_global_stats=True`` during detection
+training (``rcnn/symbol/symbol_resnet.py :: residual_unit``, eps 2e-5), so
+BN never updates and is exactly a per-channel scale/shift.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class FrozenBatchNorm(nn.Module):
+    """BatchNorm with frozen moments: y = (x - mean) / sqrt(var + eps) * γ + β.
+
+    All four tensors live in ``params`` so checkpoints carry them, but
+    ``mean``/``var`` get zero gradient by construction (they only appear
+    inside ``lax.stop_gradient``) and γ/β are excluded from the optimizer
+    via the FIXED_PARAMS mask (reference: ``FIXED_PARAMS`` incl. BN
+    gammas/betas).
+    """
+
+    eps: float = 2e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        mean = self.param("mean", nn.initializers.zeros, (c,), jnp.float32)
+        var = self.param("var", nn.initializers.ones, (c,), jnp.float32)
+        mean = jax.lax.stop_gradient(mean)
+        var = jax.lax.stop_gradient(var)
+        # fold into a single multiply-add; XLA fuses it into the conv
+        mul = scale * jax.lax.rsqrt(var + self.eps)
+        add = bias - mean * mul
+        return (x * mul.astype(self.dtype) + add.astype(self.dtype)).astype(self.dtype)
+
+
+def conv(
+    features: int,
+    kernel: int,
+    stride: int = 1,
+    dtype: Any = jnp.float32,
+    name: str | None = None,
+    use_bias: bool = False,
+    dilation: int = 1,
+) -> nn.Conv:
+    """3x3/1x1/7x7 conv helper, SAME padding, NHWC, f32 params."""
+    return nn.Conv(
+        features,
+        (kernel, kernel),
+        strides=(stride, stride),
+        padding="SAME",
+        use_bias=use_bias,
+        kernel_dilation=(dilation, dilation),
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        name=name,
+    )

@@ -1,0 +1,114 @@
+"""ResNet-50/101 backbone + conv5 top head, detection-style.
+
+Reference: ``rcnn/symbol/symbol_resnet.py`` — conv1..conv4 (stride 16) as
+the shared feature extractor, conv5 applied *after* ROI pooling as the RCNN
+head, every BN frozen (``use_global_stats=True``, eps 2e-5), conv1+stage1
+parameters frozen during training (``FIXED_PARAMS``).
+
+Architectural stance: post-activation bottleneck (conv-BN-relu) in NHWC.
+The reference uses MXNet's pre-activation variant; we keep the classic
+post-act form because it is the layout every public ImageNet ResNet
+checkpoint family uses, which keeps a future weight importer trivial, and
+is numerically equivalent in capacity.  Stage/unit naming (``stage1`` ..
+``stage4``) mirrors the reference so FIXED_PARAMS path-prefix freezing
+matches both codebases.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.models.layers import FrozenBatchNorm, conv
+
+_BLOCKS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+class Bottleneck(nn.Module):
+    """1x1 → 3x3 → 1x1(×4) bottleneck with projection shortcut."""
+
+    filters: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = conv(self.filters, 1, self.stride, self.dtype, name="conv1")(x)
+        y = FrozenBatchNorm(dtype=self.dtype, name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, 3, 1, self.dtype, name="conv2")(y)
+        y = FrozenBatchNorm(dtype=self.dtype, name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, 1, 1, self.dtype, name="conv3")(y)
+        y = FrozenBatchNorm(dtype=self.dtype, name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, 1, self.stride, self.dtype, name="sc")(x)
+            residual = FrozenBatchNorm(dtype=self.dtype, name="sc_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetStage(nn.Module):
+    filters: int
+    num_units: int
+    stride: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        for i in range(self.num_units):
+            x = Bottleneck(
+                self.filters,
+                stride=self.stride if i == 0 else 1,
+                dtype=self.dtype,
+                name=f"unit{i + 1}",
+            )(x)
+        return x
+
+
+class ResNetBackbone(nn.Module):
+    """conv1..conv4: (B, H, W, 3) → C4 feature (B, H/16, W/16, 1024).
+
+    When ``return_pyramid`` is set, also returns (C2, C3, C4, C5) for FPN —
+    C5 computed convolutionally (the FPN layout; the plain Faster R-CNN
+    path instead applies stage4 per-roi via :class:`ResNetTopHead`).
+    """
+
+    depth: int = 101
+    dtype: Any = jnp.float32
+    return_pyramid: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray):
+        blocks = _BLOCKS[self.depth]
+        x = x.astype(self.dtype)
+        x = conv(64, 7, 2, self.dtype, name="conv0")(x)
+        x = FrozenBatchNorm(dtype=self.dtype, name="bn0")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        c2 = ResNetStage(64, blocks[0], 1, self.dtype, name="stage1")(x)
+        c3 = ResNetStage(128, blocks[1], 2, self.dtype, name="stage2")(c2)
+        c4 = ResNetStage(256, blocks[2], 2, self.dtype, name="stage3")(c3)
+        if not self.return_pyramid:
+            return c4
+        c5 = ResNetStage(512, blocks[3], 2, self.dtype, name="stage4")(c4)
+        return c2, c3, c4, c5
+
+
+class ResNetTopHead(nn.Module):
+    """conv5 stage on pooled rois: (R, 14, 14, 1024) → (R, 2048) vector.
+
+    Reference: the post-ROIPooling conv5 + global-average-pool tail of
+    ``rcnn/symbol/symbol_resnet.py :: get_resnet_train``.
+    """
+
+    depth: int = 101
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, rois_feat: jnp.ndarray) -> jnp.ndarray:
+        blocks = _BLOCKS[self.depth]
+        x = ResNetStage(512, blocks[3], 2, self.dtype, name="stage4")(rois_feat)
+        return jnp.mean(x, axis=(1, 2))

@@ -1,0 +1,56 @@
+"""VGG-16 backbone + fc6/fc7 top head.
+
+Reference: ``rcnn/symbol/symbol_vgg.py :: get_vgg_conv`` (13 convs, 4
+pools → stride 16; conv1/conv2 frozen via FIXED_PARAMS) and the
+fc6/fc7(4096) head applied to 7×7 pooled rois in ``get_vgg_train``.
+NHWC, biases on (VGG has no BN).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.models.layers import conv
+
+# (number of convs, channels) per block; pool after each of the first 4
+_VGG16 = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+
+
+class VGGBackbone(nn.Module):
+    """(B, H, W, 3) → (B, H/16, W/16, 512).
+
+    Block 5 convs run at stride 16 with no trailing pool, matching the
+    reference (pool5 is replaced by ROI pooling).
+    """
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        for b, (n_convs, ch) in enumerate(_VGG16, start=1):
+            for i in range(n_convs):
+                x = conv(
+                    ch, 3, 1, self.dtype, name=f"conv{b}_{i + 1}", use_bias=True
+                )(x)
+                x = nn.relu(x)
+            if b < 5:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x
+
+
+class VGGTopHead(nn.Module):
+    """fc6/fc7 on pooled rois: (R, 7, 7, 512) → (R, 4096)."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, rois_feat: jnp.ndarray) -> jnp.ndarray:
+        x = rois_feat.reshape(rois_feat.shape[0], -1)
+        x = nn.Dense(4096, dtype=self.dtype, param_dtype=jnp.float32, name="fc6")(x)
+        x = nn.relu(x)
+        x = nn.Dense(4096, dtype=self.dtype, param_dtype=jnp.float32, name="fc7")(x)
+        return nn.relu(x)

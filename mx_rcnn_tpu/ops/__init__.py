@@ -1,0 +1,8 @@
+from mx_rcnn_tpu.ops.anchors import generate_anchors, shifted_anchors
+from mx_rcnn_tpu.ops.boxes import (
+    bbox_overlaps,
+    bbox_transform,
+    bbox_pred,
+    clip_boxes,
+)
+from mx_rcnn_tpu.ops.nms import nms, batched_class_nms

@@ -1,0 +1,84 @@
+"""Loss primitives.
+
+Reference: MXNet C++ ops ``smooth_l1`` (with ``scalar`` = sigma) and
+``SoftmaxOutput`` (with ``ignore_label=-1``, ``use_ignore``,
+``normalization='valid'``) used by ``rcnn/symbol/symbol_vgg.py`` /
+``symbol_resnet.py`` (SURVEY N7).  Rewritten as plain jnp — XLA fuses these
+into the surrounding graph, so there is nothing to hand-optimize.
+
+Normalization semantics preserved exactly:
+- RPN cls/bbox losses divide by ``RPN_BATCH_SIZE`` (256),
+- RCNN cls loss divides by valid rois, bbox loss by ``BATCH_ROIS`` (128),
+carried by the caller via the ``norm`` argument so padded/ignored entries
+keep the reference's effective learning-rate semantics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def smooth_l1(pred: jnp.ndarray, target: jnp.ndarray, sigma: float) -> jnp.ndarray:
+    """Elementwise smooth-L1 (Huber) with transition at 1/sigma².
+
+    Matches ``mx.symbol.smooth_l1(scalar=sigma)``:
+    ``0.5*(sigma*x)^2`` if ``|x| < 1/sigma²`` else ``|x| - 0.5/sigma²``.
+    """
+    sigma2 = sigma * sigma
+    diff = pred - target
+    adiff = jnp.abs(diff)
+    return jnp.where(
+        adiff < 1.0 / sigma2,
+        0.5 * sigma2 * diff * diff,
+        adiff - 0.5 / sigma2,
+    )
+
+
+def weighted_smooth_l1(
+    pred: jnp.ndarray,
+    target: jnp.ndarray,
+    weight: jnp.ndarray,
+    sigma: float,
+    norm: jnp.ndarray | float,
+) -> jnp.ndarray:
+    """sum(weight * smooth_l1) / norm — the ``smooth_l1 × bbox_weight``
+    with ``grad_scale 1/N`` pattern of the reference train graphs."""
+    return jnp.sum(weight * smooth_l1(pred, target, sigma)) / norm
+
+
+def softmax_cross_entropy(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    ignore_label: int = -1,
+    norm: jnp.ndarray | float | None = None,
+) -> jnp.ndarray:
+    """Mean softmax CE over entries whose label != ignore_label.
+
+    Matches ``SoftmaxOutput(use_ignore=True, ignore_label=-1,
+    normalization='valid')``: ignored entries contribute zero loss and zero
+    gradient.  ``norm`` overrides the divisor (e.g. a fixed 256 for RPN).
+    """
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_label
+    safe_labels = jnp.where(valid, labels, 0).astype(jnp.int32)
+    logz = jnp.log(jnp.sum(jnp.exp(logits - logits.max(-1, keepdims=True)), -1))
+    ll = jnp.take_along_axis(
+        logits - logits.max(-1, keepdims=True), safe_labels[..., None], axis=-1
+    )[..., 0]
+    nll = (logz - ll) * valid
+    if norm is None:
+        norm = jnp.maximum(valid.sum(), 1)
+    return jnp.sum(nll) / norm
+
+
+def accuracy(
+    logits: jnp.ndarray, labels: jnp.ndarray, ignore_label: int = -1
+) -> jnp.ndarray:
+    """Classification accuracy over non-ignored entries (metric, not loss).
+
+    Reference: ``rcnn/core/metric.py :: RPNAccMetric / RCNNAccMetric``.
+    """
+    valid = labels != ignore_label
+    pred = jnp.argmax(logits, axis=-1)
+    correct = (pred == labels) & valid
+    return correct.sum() / jnp.maximum(valid.sum(), 1)

@@ -1,0 +1,21 @@
+"""Test harness: run everything on CPU with 8 virtual devices.
+
+This is the TPU-world "fake backend" the reference never had (SURVEY §5.1):
+multi-chip sharding paths compile and execute on 8 XLA host devices, so DP
+correctness is tested without hardware.  Must run before jax is imported.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

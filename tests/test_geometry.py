@@ -1,0 +1,128 @@
+"""Golden tests for the geometry core (SURVEY §5.1: unit-test every pure
+geometry fn against hand-computed / canonical py-faster-rcnn outputs)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.ops import (
+    bbox_overlaps,
+    bbox_pred,
+    bbox_transform,
+    clip_boxes,
+    generate_anchors,
+    shifted_anchors,
+)
+
+
+class TestGenerateAnchors:
+    def test_canonical_output(self):
+        # the canonical py-faster-rcnn table for base 16, ratios .5/1/2,
+        # scales 8/16/32 (printed in the original generate_anchors.py)
+        expected = np.array(
+            [
+                [-84., -40., 99., 55.],
+                [-176., -88., 191., 103.],
+                [-360., -184., 375., 199.],
+                [-56., -56., 71., 71.],
+                [-120., -120., 135., 135.],
+                [-248., -248., 263., 263.],
+                [-36., -80., 51., 95.],
+                [-80., -168., 95., 183.],
+                [-168., -344., 183., 359.],
+            ]
+        )
+        got = generate_anchors(16, (0.5, 1.0, 2.0), (8, 16, 32))
+        np.testing.assert_allclose(got, expected)
+
+    def test_shapes_and_center(self):
+        a = generate_anchors(16, (1.0,), (1,))
+        np.testing.assert_allclose(a, [[0.0, 0.0, 15.0, 15.0]])
+
+    def test_shifted_grid(self):
+        a = shifted_anchors(2, 3, feat_stride=16, ratios=(1.0,), scales=(1,))
+        assert a.shape == (6, 4)
+        # row-major over (y, x): second anchor shifted by stride in x
+        np.testing.assert_allclose(a[1] - a[0], [16, 0, 16, 0])
+        np.testing.assert_allclose(a[3] - a[0], [0, 16, 0, 16])
+
+
+class TestBboxOverlaps:
+    def test_hand_computed(self):
+        boxes = jnp.array([[0.0, 0.0, 9.0, 9.0]])        # area 100
+        query = jnp.array(
+            [
+                [0.0, 0.0, 9.0, 9.0],                     # identical → 1
+                [5.0, 5.0, 14.0, 14.0],                   # inter 25, union 175
+                [20.0, 20.0, 29.0, 29.0],                 # disjoint → 0
+            ]
+        )
+        got = bbox_overlaps(boxes, query)
+        np.testing.assert_allclose(got, [[1.0, 25.0 / 175.0, 0.0]], atol=1e-6)
+
+    def test_matches_numpy_reference(self, rng):
+        def np_overlaps(boxes, query):
+            n, k = boxes.shape[0], query.shape[0]
+            out = np.zeros((n, k))
+            for i in range(n):
+                for j in range(k):
+                    iw = min(boxes[i, 2], query[j, 2]) - max(boxes[i, 0], query[j, 0]) + 1
+                    ih = min(boxes[i, 3], query[j, 3]) - max(boxes[i, 1], query[j, 1]) + 1
+                    if iw > 0 and ih > 0:
+                        ua = (
+                            (boxes[i, 2] - boxes[i, 0] + 1) * (boxes[i, 3] - boxes[i, 1] + 1)
+                            + (query[j, 2] - query[j, 0] + 1) * (query[j, 3] - query[j, 1] + 1)
+                            - iw * ih
+                        )
+                        out[i, j] = iw * ih / ua
+            return out
+
+        boxes = rng.rand(20, 4) * 50
+        boxes[:, 2:] += boxes[:, :2] + 1
+        query = rng.rand(13, 4) * 50
+        query[:, 2:] += query[:, :2] + 1
+        np.testing.assert_allclose(
+            bbox_overlaps(jnp.array(boxes), jnp.array(query)),
+            np_overlaps(boxes, query),
+            rtol=1e-4,
+            atol=1e-6,
+        )
+
+
+class TestBboxTransform:
+    def test_roundtrip(self, rng):
+        ex = rng.rand(50, 4).astype(np.float32) * 100
+        ex[:, 2:] += ex[:, :2] + 5
+        gt = rng.rand(50, 4).astype(np.float32) * 100
+        gt[:, 2:] += gt[:, :2] + 5
+        deltas = bbox_transform(jnp.array(ex), jnp.array(gt))
+        rec = bbox_pred(jnp.array(ex), deltas)
+        np.testing.assert_allclose(rec, gt, atol=1e-2)
+
+    def test_zero_delta_identity(self):
+        boxes = jnp.array([[10.0, 10.0, 20.0, 30.0]])
+        out = bbox_pred(boxes, jnp.zeros((1, 4)))
+        np.testing.assert_allclose(out, boxes, atol=1e-5)
+
+    def test_known_encode(self):
+        # shift a 10-wide box right by its width: dx = 1.0 exactly
+        ex = jnp.array([[0.0, 0.0, 9.0, 9.0]])
+        gt = jnp.array([[10.0, 0.0, 19.0, 9.0]])
+        d = bbox_transform(ex, gt)
+        np.testing.assert_allclose(d, [[1.0, 0.0, 0.0, 0.0]], atol=1e-6)
+
+    def test_class_specific_decode(self, rng):
+        boxes = jnp.array(rng.rand(7, 4).astype(np.float32) * 50)
+        deltas = jnp.array(rng.randn(7, 12).astype(np.float32) * 0.1)
+        out = bbox_pred(boxes, deltas)
+        assert out.shape == (7, 12)
+        # each 4-block decodes independently
+        per = bbox_pred(boxes, deltas[:, 4:8])
+        np.testing.assert_allclose(out[:, 4:8], per, rtol=1e-5)
+
+
+class TestClipBoxes:
+    def test_clip(self):
+        boxes = jnp.array([[-10.0, -5.0, 700.0, 400.0, 5.0, 5.0, 7.0, 8.0]])
+        out = clip_boxes(boxes, (300, 500))
+        np.testing.assert_allclose(out, [[0, 0, 499, 299, 5, 5, 7, 8]])

@@ -1,0 +1,78 @@
+"""NMS contract tests: jittable masked NMS vs the greedy numpy oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.ops.nms import batched_class_nms, nms, nms_mask, nms_numpy
+
+
+def random_dets(rng, n, span=100.0):
+    boxes = rng.rand(n, 4).astype(np.float32) * span
+    boxes[:, 2:] = boxes[:, :2] + rng.rand(n, 2).astype(np.float32) * span * 0.5 + 1
+    scores = rng.rand(n).astype(np.float32)
+    return boxes, scores
+
+
+class TestNmsMask:
+    @pytest.mark.parametrize("thresh", [0.3, 0.5, 0.7])
+    @pytest.mark.parametrize("n", [1, 17, 200])
+    def test_matches_numpy_oracle(self, rng, thresh, n):
+        boxes, scores = random_dets(rng, n)
+        keep = np.asarray(nms_mask(jnp.array(boxes), jnp.array(scores), thresh))
+        dets = np.hstack([boxes, scores[:, None]])
+        expected = set(nms_numpy(dets, thresh))
+        assert set(np.where(keep)[0]) == expected
+
+    def test_invalid_never_suppresses(self, rng):
+        # an invalid high-score box overlapping a valid one must not kill it
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], dtype=np.float32)
+        scores = np.array([0.9, 0.5], dtype=np.float32)
+        valid = np.array([False, True])
+        keep = np.asarray(
+            nms_mask(jnp.array(boxes), jnp.array(scores), 0.3, jnp.array(valid))
+        )
+        assert keep.tolist() == [False, True]
+
+    def test_jit_stable(self, rng):
+        boxes, scores = random_dets(rng, 64)
+        f = jax.jit(lambda b, s: nms_mask(b, s, 0.5))
+        a = np.asarray(f(jnp.array(boxes), jnp.array(scores)))
+        b = np.asarray(nms_mask(jnp.array(boxes), jnp.array(scores), 0.5))
+        assert (a == b).all()
+
+
+class TestNmsTopK:
+    def test_fixed_shape_and_order(self, rng):
+        boxes, scores = random_dets(rng, 100)
+        out_boxes, out_scores, out_valid = nms(
+            jnp.array(boxes), jnp.array(scores), 0.5, max_out=32
+        )
+        assert out_boxes.shape == (32, 4)
+        s = np.asarray(out_scores)
+        v = np.asarray(out_valid)
+        # survivors come first, descending
+        assert (np.diff(s[v]) <= 1e-6).all()
+        # padding rows are zeroed
+        assert (np.asarray(out_boxes)[~v] == 0).all()
+
+    def test_padding_when_few_survivors(self):
+        # two heavily-overlapping boxes → 1 survivor, 7 pad rows
+        boxes = jnp.array([[0, 0, 10, 10], [0, 0, 10, 11]], dtype=jnp.float32)
+        scores = jnp.array([0.9, 0.8])
+        _, _, valid = nms(boxes, scores, 0.5, max_out=8)
+        assert int(valid.sum()) == 1
+
+    def test_batched_class_nms(self, rng):
+        C, N = 4, 50
+        boxes = np.stack([random_dets(rng, N)[0] for _ in range(C)])
+        scores = rng.rand(C, N).astype(np.float32)
+        ob, os_, ov = batched_class_nms(jnp.array(boxes), jnp.array(scores), 0.3, 16)
+        assert ob.shape == (C, 16, 4)
+        for c in range(C):
+            dets = np.hstack([boxes[c], scores[c][:, None]])
+            expected = nms_numpy(dets, 0.3)[:16]
+            got_scores = np.sort(np.asarray(os_[c])[np.asarray(ov[c])])[::-1]
+            exp_scores = np.sort(scores[c][expected])[::-1]
+            np.testing.assert_allclose(got_scores, exp_scores, rtol=1e-6)
