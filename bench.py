@@ -1,0 +1,80 @@
+"""Benchmark: ResNet-101 Faster R-CNN end-to-end train throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "imgs/sec/chip", "vs_baseline": N/30}
+
+Baseline = the 30 imgs/sec/chip north-star target from BASELINE.json
+(the reference never published per-chip throughput; its GPU-era numbers
+were O(2-5) imgs/sec/GPU).
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC_PER_CHIP = 30.0
+
+
+def main():
+    import jax
+
+    from mx_rcnn_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
+
+    from __graft_entry__ import _batch, _flagship_cfg
+    from mx_rcnn_tpu.core.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from mx_rcnn_tpu.models import FasterRCNN
+
+    cfg = _flagship_cfg()
+    model = FasterRCNN(cfg)
+    h, w = cfg.SHAPE_BUCKETS[0]
+    b = cfg.TRAIN.BATCH_IMAGES
+    batch = _batch(cfg, b, h, w)
+    params = model.init(
+        {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+        batch["images"],
+        batch["im_info"],
+        batch["gt_boxes"],
+        batch["gt_valid"],
+        train=True,
+    )["params"]
+    tx = make_optimizer(cfg, lambda s: cfg.TRAIN.LEARNING_RATE)
+    state = create_train_state(params, tx)
+    step = make_train_step(model, tx, donate=True)
+
+    rng = jax.random.key(0)
+    # warmup / compile (value fetch = the only trustworthy sync on the
+    # axon relay; block_until_ready returns early there)
+    state, aux = step(state, batch, rng)
+    float(aux["loss"])
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, aux = step(state, batch, rng)
+    # the final loss depends on every chained step, so this fetch forces
+    # the whole sequence; one ~85ms tunnel roundtrip amortized over iters
+    assert np.isfinite(float(aux["loss"]))
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = b * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "train_imgs_per_sec_per_chip_resnet101_e2e",
+                "value": round(imgs_per_sec, 3),
+                "unit": "imgs/sec/chip",
+                "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Checkpoint save/restore via Orbax.
+
+Reference: ``rcnn/core/callback.py :: do_checkpoint`` +
+``rcnn/utils/{save_model,load_model}.py`` — MXNet json+params pairs with
+the bbox-weight de-normalization quirk (SURVEY §5.5).  Here: raw pytree
+state (params + optimizer + step) via Orbax, normalization never folded
+into weights, and resume restores momentum too (the reference restarted
+momentum cold — a known wart we fix).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from mx_rcnn_tpu.core.train import TrainState
+
+
+def save_checkpoint(prefix: str, state: TrainState, epoch: int) -> str:
+    """Save to ``{prefix}/epoch_{epoch:04d}`` (one dir per epoch, like the
+    reference's ``prefix-%04d.params`` naming)."""
+    path = os.path.abspath(os.path.join(prefix, f"epoch_{epoch:04d}"))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, jax.device_get(state), force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def load_checkpoint(prefix: str, epoch: int, target: TrainState) -> TrainState:
+    path = os.path.abspath(os.path.join(prefix, f"epoch_{epoch:04d}"))
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(path, target=jax.device_get(target))
+
+
+def latest_epoch(prefix: str) -> Optional[int]:
+    if not os.path.isdir(prefix):
+        return None
+    epochs = [
+        int(d.split("_")[1])
+        for d in os.listdir(prefix)
+        if d.startswith("epoch_") and d.split("_")[1].isdigit()
+    ]
+    return max(epochs) if epochs else None

@@ -1,0 +1,76 @@
+"""Running metric aggregation + throughput logging.
+
+Reference: ``rcnn/core/metric.py`` (six EvalMetrics) and
+``rcnn/core/callback.py :: Speedometer``.  The metric *values* are
+computed inside the jitted train step (``FasterRCNN.train_forward`` aux
+dict, same names); this module only accumulates host-side scalars and
+prints in the reference's log format so runs are comparable line-by-line.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Iterable
+
+logger = logging.getLogger(__name__)
+
+METRIC_NAMES = (
+    "RPNAcc",
+    "RPNLogLoss",
+    "RPNL1Loss",
+    "RCNNAcc",
+    "RCNNLogLoss",
+    "RCNNL1Loss",
+)
+
+
+class MetricTracker:
+    """Running means, reset per logging interval (EvalMetric twin)."""
+
+    def __init__(self, names: Iterable[str] = METRIC_NAMES):
+        self.names = tuple(names)
+        self.reset()
+
+    def reset(self) -> None:
+        self._sums = {n: 0.0 for n in self.names}
+        self._count = 0
+
+    def update(self, aux: Dict[str, float]) -> None:
+        for n in self.names:
+            if n in aux:
+                self._sums[n] += float(aux[n])
+        self._count += 1
+
+    def get(self) -> Dict[str, float]:
+        c = max(self._count, 1)
+        return {n: self._sums[n] / c for n in self.names}
+
+    def format(self) -> str:
+        return ",\t".join(f"{n}={v:.6f}" for n, v in self.get().items())
+
+
+class Speedometer:
+    """imgs/sec logging every ``frequent`` batches (callback.py twin)."""
+
+    def __init__(self, batch_size: int, frequent: int = 20):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self._tic = time.time()
+        self._last = 0
+
+    def __call__(self, epoch: int, step: int, tracker: MetricTracker) -> None:
+        if step % self.frequent != 0 or step == self._last:
+            return
+        elapsed = time.time() - self._tic
+        speed = self.frequent * self.batch_size / max(elapsed, 1e-9)
+        logger.info(
+            "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s",
+            epoch,
+            step,
+            speed,
+            tracker.format(),
+        )
+        tracker.reset()
+        self._tic = time.time()
+        self._last = step

@@ -1,0 +1,147 @@
+"""Inference: Predictor, im_detect, pred_eval, generate_proposals.
+
+Reference: ``rcnn/core/tester.py`` — ``Predictor`` (bound forward-only
+module), ``im_detect`` (decode + clip + unscale), ``pred_eval`` (dataset
+loop → per-class NMS → ``imdb.evaluate_detections``), and
+``generate_proposals`` (dump RPN proposals for alternate training).
+
+The device side is one jitted test forward per shape bucket; the host
+side (per-class thresholding/NMS, detection accumulation) stays numpy
+exactly like the reference — eval is offline and host NMS on ≤300 boxes
+is microseconds.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
+from mx_rcnn_tpu.ops.nms import nms_numpy
+
+logger = logging.getLogger(__name__)
+
+
+class Predictor:
+    """Jitted forward-only wrapper (Predictor twin).  One compile per
+    shape bucket — the TPU replacement for MutableModule max-shape
+    binding."""
+
+    def __init__(self, model, params):
+        self.model = model
+        self.params = params
+        self._fn = jax.jit(
+            lambda p, images, im_info: model.apply(
+                {"params": p}, images, im_info, train=False
+            )
+        )
+
+    def predict(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out = self._fn(self.params, batch["images"], batch["im_info"])
+        return jax.device_get(out)
+
+
+def im_detect(
+    output: Dict[str, np.ndarray], im_info: np.ndarray, orig_hw
+) -> Dict[str, np.ndarray]:
+    """Decode one image's raw head outputs into image-space detections.
+
+    Reference: ``rcnn/core/tester.py :: im_detect`` — class-specific
+    delta decode, clip to the *resized* image, then divide by scale back
+    to original coordinates.
+    """
+    rois = output["rois"][0]
+    valid = output["roi_valid"][0].astype(bool)
+    scores = output["cls_prob"][0]
+    deltas = output["bbox_deltas"][0]
+    scale = float(im_info[2])
+
+    boxes = np.asarray(bbox_pred(rois, deltas))
+    boxes = np.asarray(clip_boxes(boxes, (float(im_info[0]), float(im_info[1]))))
+    boxes = boxes / scale
+    # final clip to the original image extent
+    h, w = orig_hw
+    boxes = np.asarray(clip_boxes(boxes, (float(h), float(w))))
+    return {"scores": scores[valid], "boxes": boxes[valid]}
+
+
+def pred_eval(
+    predictor: Predictor,
+    loader,
+    imdb,
+    cfg: Config,
+    thresh: Optional[float] = None,
+    vis: bool = False,
+):
+    """Full-dataset evaluation loop (pred_eval twin).
+
+    Returns (all_boxes, eval_results) where
+    ``all_boxes[cls][img] = (n, 5)``.
+    """
+    te = cfg.TEST
+    thresh = te.SCORE_THRESH if thresh is None else thresh
+    num_classes = imdb.num_classes
+    num_images = len(loader)
+    all_boxes: List[List[np.ndarray]] = [
+        [np.zeros((0, 5), np.float32) for _ in range(num_images)]
+        for _ in range(num_classes)
+    ]
+    t0 = time.time()
+    for i, (rec, batch) in enumerate(loader):
+        out = predictor.predict(batch)
+        det = im_detect(out, batch["im_info"][0], (rec["height"], rec["width"]))
+        scores, boxes = det["scores"], det["boxes"]
+        for j in range(1, num_classes):
+            keep = np.where(scores[:, j] > thresh)[0]
+            cls_dets = np.hstack(
+                [boxes[keep, j * 4 : (j + 1) * 4], scores[keep, j : j + 1]]
+            ).astype(np.float32)
+            keep_nms = nms_numpy(cls_dets, te.NMS)
+            all_boxes[j][i] = cls_dets[keep_nms]
+        # cap detections per image across classes (COCO: 100)
+        if te.MAX_PER_IMAGE > 0:
+            all_scores = np.concatenate(
+                [all_boxes[j][i][:, 4] for j in range(1, num_classes)]
+            )
+            if len(all_scores) > te.MAX_PER_IMAGE:
+                cut = np.sort(all_scores)[-te.MAX_PER_IMAGE]
+                for j in range(1, num_classes):
+                    keep = all_boxes[j][i][:, 4] >= cut
+                    all_boxes[j][i] = all_boxes[j][i][keep]
+        if (i + 1) % 100 == 0:
+            logger.info(
+                "im_detect %d/%d %.3fs/im", i + 1, num_images, (time.time() - t0) / (i + 1)
+            )
+    results = imdb.evaluate_detections(all_boxes)
+    return all_boxes, results
+
+
+def generate_proposals(
+    predictor: Predictor, loader, cfg: Config, dump_path: Optional[str] = None
+) -> List[np.ndarray]:
+    """Run the RPN over a dataset and keep proposals per image, for the
+    alternate-training pipeline and proposal-recall eval.
+
+    Reference: ``rcnn/core/tester.py :: generate_proposals`` (+ the
+    ``.pkl`` dump consumed by ``load_proposal_roidb``).
+    """
+    proposals = []
+    for rec, batch in loader:
+        out = predictor.predict(batch)
+        rois = out["rois"][0]
+        valid = out["roi_valid"][0].astype(bool)
+        scale = float(batch["im_info"][0][2])
+        boxes = rois[valid] / scale
+        scores = np.asarray(out["roi_scores"][0])[valid]
+        dets = np.hstack([boxes, scores[:, None]]).astype(np.float32)
+        proposals.append(dets)
+    if dump_path:
+        with open(dump_path, "wb") as f:
+            pickle.dump(proposals, f, pickle.HIGHEST_PROTOCOL)
+    return proposals

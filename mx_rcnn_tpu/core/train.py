@@ -1,0 +1,129 @@
+"""Trainer: optimizer, parameter freezing, jitted train step.
+
+Reference: the ``MutableModule.fit`` + SGD + KVStore('device') stack of
+``train_end2end.py :: train_net`` and ``rcnn/core/module.py`` (SURVEY
+§4.1).  TPU-native shape: one pure ``train_step`` (value_and_grad →
+element-wise clip → wd → momentum → piecewise lr), jitted per shape
+bucket; data parallelism is the same function under ``shard_map`` with a
+``psum`` on grads (``mx_rcnn_tpu/parallel``) — the comm backend is the
+compiler.
+
+Optimizer semantics match MXNet SGD exactly:
+- gradient clipped element-wise to ±CLIP_GRADIENT (MXNet ``clip_gradient``),
+- weight decay added to the gradient *before* momentum (MXNet SGD),
+- momentum 0.9, piecewise-constant lr (MultiFactorScheduler),
+- frozen params (FIXED_PARAMS) get zero updates via an optax mask.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Sequence, Tuple
+
+import flax
+import jax
+import jax.numpy as jnp
+import optax
+
+from mx_rcnn_tpu.config import Config
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+
+def is_frozen_path(path: Tuple[str, ...], fixed_params: Sequence[str]) -> bool:
+    """Reference FIXED_PARAMS semantics: freeze whole subtrees by name
+    prefix (conv0/stage1/conv1...) plus every BN affine/stat network-wide
+    (the reference lists gamma/beta; our FrozenBatchNorm names them
+    scale/bias/mean/var under modules containing 'bn')."""
+    for comp in path:
+        for pat in fixed_params:
+            if pat == "bn":
+                if "bn" in comp:
+                    return True
+            elif comp == pat or comp.startswith(pat):
+                return True
+    # running stats are never trainable regardless of config
+    return path[-1] in ("mean", "var")
+
+
+def make_optimizer(
+    cfg: Config, lr_schedule: Callable[[jnp.ndarray], jnp.ndarray]
+) -> optax.GradientTransformation:
+    t = cfg.TRAIN
+    sgd = optax.chain(
+        optax.clip(t.CLIP_GRADIENT),
+        optax.add_decayed_weights(t.WD),
+        optax.trace(decay=t.MOMENTUM, nesterov=False),
+        optax.scale_by_schedule(lambda step: -lr_schedule(step)),
+    )
+
+    def label_fn(params):
+        flat = flax.traverse_util.flatten_dict(params)
+        labels = {
+            k: "frozen" if is_frozen_path(k, cfg.network.FIXED_PARAMS) else "train"
+            for k in flat
+        }
+        return flax.traverse_util.unflatten_dict(labels)
+
+    return optax.multi_transform(
+        {"train": sgd, "frozen": optax.set_to_zero()}, label_fn
+    )
+
+
+def make_lr_schedule(cfg: Config, steps_per_epoch: int) -> Callable:
+    """MultiFactorScheduler twin: lr × LR_FACTOR at each LR_STEP epoch."""
+    t = cfg.TRAIN
+    boundaries = {
+        int(e * steps_per_epoch): t.LR_FACTOR for e in t.LR_STEP_EPOCHS
+    }
+    return optax.piecewise_constant_schedule(t.LEARNING_RATE, boundaries)
+
+
+def create_train_state(params, tx: optax.GradientTransformation) -> TrainState:
+    return TrainState(jnp.zeros((), jnp.int32), params, tx.init(params))
+
+
+def make_train_step(
+    model, tx: optax.GradientTransformation, donate: bool = True, pmean_axis: str | None = None
+):
+    """Build the jitted train step.
+
+    ``pmean_axis``: when running under shard_map/pmap, the named mesh axis
+    to average grads/metrics over (the KVStore('device') replacement);
+    None for single-chip.
+    """
+
+    def step_fn(state: TrainState, batch: Dict[str, jnp.ndarray], rng: jax.Array):
+        rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(params):
+            loss, aux = model.apply(
+                {"params": params},
+                batch["images"],
+                batch["im_info"],
+                batch["gt_boxes"],
+                batch["gt_valid"],
+                train=True,
+                rngs={"sampling": rng},
+            )
+            return loss, aux
+
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        aux = dict(aux)
+        aux["loss"] = loss
+        if pmean_axis is not None:
+            grads = jax.lax.pmean(grads, pmean_axis)
+            aux = jax.lax.pmean(
+                {k: v.astype(jnp.float32) for k, v in aux.items()}, pmean_axis
+            )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(state.step + 1, params, opt_state)
+        return new_state, aux
+
+    if pmean_axis is not None:
+        return step_fn  # caller wraps in shard_map then jit
+    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,95 @@
+"""Image loading, resizing, normalization, bucket padding.
+
+Reference: ``rcnn/io/image.py`` (cv2 BGR→RGB read, short-side/long-cap
+``resize``, mean-subtract ``transform``, ragged ``tensor_vstack``).  The
+TPU twist: instead of stacking to the max shape in each batch (which gives
+unbounded distinct shapes → unbounded XLA recompiles, the problem
+``MutableModule`` re-binding solved on GPU), every image lands in one of a
+small static set of (H, W) *buckets* (SURVEY §5.7); ``im_info`` carries
+the true pre-padding size so in-graph ops mask the padding.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import cv2
+import numpy as np
+
+
+def load_image(path: str) -> np.ndarray:
+    """Read RGB float32 HWC (reference reads BGR then flips to RGB)."""
+    im = cv2.imread(path, cv2.IMREAD_COLOR)
+    if im is None:
+        raise FileNotFoundError(path)
+    return cv2.cvtColor(im, cv2.COLOR_BGR2RGB).astype(np.float32)
+
+
+def resize_im(
+    im: np.ndarray, target_size: int, max_size: int
+) -> Tuple[np.ndarray, float]:
+    """Short side → ``target_size`` capped so long side ≤ ``max_size``.
+
+    Reference: ``rcnn/io/image.py :: resize``.
+    """
+    h, w = im.shape[:2]
+    short, long_ = min(h, w), max(h, w)
+    scale = float(target_size) / short
+    if round(scale * long_) > max_size:
+        scale = float(max_size) / long_
+    im = cv2.resize(im, None, fx=scale, fy=scale, interpolation=cv2.INTER_LINEAR)
+    return im, scale
+
+
+def normalize(im: np.ndarray, pixel_means, pixel_stds) -> np.ndarray:
+    """(H, W, 3) RGB → normalized float32 (transform() twin, NHWC not NCHW)."""
+    return (im - np.asarray(pixel_means, np.float32)) / np.asarray(
+        pixel_stds, np.float32
+    )
+
+
+def denormalize(im: np.ndarray, pixel_means, pixel_stds) -> np.ndarray:
+    """transform_inverse() twin, for visualization."""
+    out = im * np.asarray(pixel_stds, np.float32) + np.asarray(
+        pixel_means, np.float32
+    )
+    return np.clip(out, 0, 255).astype(np.uint8)
+
+
+def pick_bucket(
+    h: int, w: int, buckets: Sequence[Tuple[int, int]]
+) -> Tuple[int, int]:
+    """Smallest bucket that contains (h, w); falls back to the largest-area
+    bucket (callers guarantee resized images fit by construction)."""
+    fitting = [b for b in buckets if b[0] >= h and b[1] >= w]
+    if fitting:
+        return min(fitting, key=lambda b: b[0] * b[1])
+    return max(buckets, key=lambda b: b[0] * b[1])
+
+
+def pad_to_bucket(im: np.ndarray, bucket: Tuple[int, int]) -> np.ndarray:
+    """Zero-pad bottom/right to the bucket shape (boxes stay valid)."""
+    h, w = im.shape[:2]
+    bh, bw = bucket
+    out = np.zeros((bh, bw) + im.shape[2:], dtype=im.dtype)
+    out[: min(h, bh), : min(w, bw)] = im[: min(h, bh), : min(w, bw)]
+    return out
+
+
+def prepare_image(
+    im: np.ndarray,
+    target_size: int,
+    max_size: int,
+    pixel_means,
+    pixel_stds,
+    buckets: Sequence[Tuple[int, int]],
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Full per-image path: resize → normalize → bucket-pad.
+
+    Returns (padded image, im_info=(resized_h, resized_w, scale)).
+    """
+    im, scale = resize_im(im, target_size, max_size)
+    h, w = im.shape[:2]
+    im = normalize(im, pixel_means, pixel_stds)
+    im = pad_to_bucket(im, pick_bucket(h, w, buckets))
+    return im, np.array([h, w, scale], np.float32)

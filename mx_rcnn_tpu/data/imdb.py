@@ -1,0 +1,100 @@
+"""Dataset base class (IMDB) and the roidb record format.
+
+Reference: ``rcnn/dataset/imdb.py :: IMDB`` — name/classes/image index,
+pickle roidb cache under ``data/cache``, ``append_flipped_images`` (x-flip
+boxes with validity asserts), abstract ``evaluate_detections``.
+
+roidb record keys (superset of the reference's, minus the
+selective-search legacy fields):
+  image (path), height, width, boxes (n, 4) f32, gt_classes (n,) i32,
+  flipped (bool).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List
+
+import numpy as np
+
+
+class IMDB:
+    def __init__(self, name: str, root_path: str):
+        self.name = name
+        self.root_path = root_path
+        self.classes: List[str] = []
+        self.image_set_index: List[str] = []
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.classes)
+
+    @property
+    def num_images(self) -> int:
+        return len(self.image_set_index)
+
+    @property
+    def cache_path(self) -> str:
+        path = os.path.join(self.root_path, "cache")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    # -- roidb ------------------------------------------------------------
+    def gt_roidb(self) -> List[Dict]:
+        raise NotImplementedError
+
+    def load_cached(self, tag: str, build_fn):
+        """Pickle cache identical in spirit to the reference's
+        ``data/cache/{name}_{tag}.pkl`` files."""
+        cache_file = os.path.join(self.cache_path, f"{self.name}_{tag}.pkl")
+        if os.path.exists(cache_file):
+            with open(cache_file, "rb") as f:
+                return pickle.load(f)
+        data = build_fn()
+        with open(cache_file, "wb") as f:
+            pickle.dump(data, f, pickle.HIGHEST_PROTOCOL)
+        return data
+
+    def evaluate_detections(self, detections, **kwargs):
+        """``detections[cls][img]`` = (n, 5) [x1, y1, x2, y2, score]."""
+        raise NotImplementedError
+
+    # -- augmentation -----------------------------------------------------
+    @staticmethod
+    def append_flipped_images(roidb: List[Dict]) -> List[Dict]:
+        """Double the roidb with x-flipped copies.
+
+        Reference: ``rcnn/dataset/imdb.py :: append_flipped_images``
+        (including its box-validity assertion).
+        """
+        flipped = []
+        for rec in roidb:
+            boxes = rec["boxes"].copy()
+            if len(boxes):
+                oldx1 = boxes[:, 0].copy()
+                oldx2 = boxes[:, 2].copy()
+                boxes[:, 0] = rec["width"] - oldx2 - 1
+                boxes[:, 2] = rec["width"] - oldx1 - 1
+                assert (boxes[:, 2] >= boxes[:, 0]).all()
+            new_rec = dict(rec)
+            new_rec["boxes"] = boxes
+            new_rec["flipped"] = True
+            flipped.append(new_rec)
+        return list(roidb) + flipped
+
+
+def filter_roidb(roidb: List[Dict]) -> List[Dict]:
+    """Drop images without any gt box (reference:
+    ``rcnn/utils/load_data.py :: filter_roidb``)."""
+    kept = [r for r in roidb if len(r["boxes"]) > 0]
+    return kept
+
+
+def merge_roidbs(roidbs: List[List[Dict]]) -> List[Dict]:
+    """Concatenate roidbs of multiple image sets (07+12 training;
+    reference: ``rcnn/utils/load_data.py :: merge_roidb``)."""
+    out: List[Dict] = []
+    for r in roidbs:
+        out.extend(r)
+    return out

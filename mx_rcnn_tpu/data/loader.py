@@ -1,0 +1,183 @@
+"""Batch loaders: roidb → padded device-ready numpy batches.
+
+Reference: ``rcnn/core/loader.py`` (``AnchorLoader`` / ``ROIIter`` /
+``TestLoader``).  Radically simpler here because anchor-target assignment
+and roi sampling moved *inside* the jitted graph: the loader only decodes
+images, resizes into shape buckets, and pads gt boxes — no
+``feat_sym.infer_shape``, no per-image ``assign_anchor`` on host, no
+per-GPU slicing (sharding is a jax.sharding concern, not a loader
+concern).
+
+Keeps the reference's aspect-ratio grouping trick (``AnchorLoader``'s
+aspect grouping): batches are drawn from one orientation bucket at a
+time so every image in a batch pads into the same (H, W) bucket and the
+jit cache stays bounded at #buckets graphs.
+
+A small background-thread prefetcher overlaps cv2 decode with TPU steps
+(the reference relied on MXNet's async engine for the same overlap).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.data.image import load_image, pick_bucket, prepare_image
+
+
+def _load_record_image(rec: Dict) -> np.ndarray:
+    if str(rec["image"]).startswith("synthetic://"):
+        from mx_rcnn_tpu.data.synthetic import synthetic_image
+
+        im = synthetic_image(rec, rec["synthetic_seed"])
+    else:
+        im = load_image(rec["image"])
+    if rec.get("flipped"):
+        im = im[:, ::-1]
+    return im
+
+
+def make_batch(
+    records: Sequence[Dict],
+    cfg: Config,
+    bucket: Tuple[int, int],
+    images: Optional[Sequence[np.ndarray]] = None,
+) -> Dict[str, np.ndarray]:
+    """Assemble one padded train batch from roidb records.
+
+    Boxes are scaled by the resize factor (the reference scales gt_boxes by
+    im_scale in ``get_rpn_batch``); gt arrays padded to MAX_GT_BOXES.
+    """
+    scales = cfg.dataset.SCALES[0]
+    g = cfg.dataset.MAX_GT_BOXES
+    n = len(records)
+    bh, bw = bucket
+    out_images = np.zeros((n, bh, bw, 3), np.float32)
+    im_info = np.zeros((n, 3), np.float32)
+    gt_boxes = np.zeros((n, g, 5), np.float32)
+    gt_valid = np.zeros((n, g), bool)
+    for i, rec in enumerate(records):
+        im = images[i] if images is not None else _load_record_image(rec)
+        padded, info = prepare_image(
+            im,
+            scales[0],
+            scales[1],
+            cfg.network.PIXEL_MEANS,
+            cfg.network.PIXEL_STDS,
+            [bucket],
+        )
+        out_images[i] = padded
+        im_info[i] = info
+        boxes = rec["boxes"] * info[2]
+        k = min(len(boxes), g)
+        gt_boxes[i, :k, :4] = boxes[:k]
+        gt_boxes[i, :k, 4] = rec["gt_classes"][:k]
+        gt_valid[i, :k] = True
+    return {
+        "images": out_images,
+        "im_info": im_info,
+        "gt_boxes": gt_boxes,
+        "gt_valid": gt_valid,
+    }
+
+
+def _orientation_bucket(rec: Dict, buckets) -> Tuple[int, int]:
+    """Pick the bucket a record will land in post-resize (h<=w → wide)."""
+    wide = rec["width"] >= rec["height"]
+    for b in buckets:
+        if (b[1] >= b[0]) == wide:
+            return tuple(b)
+    return tuple(buckets[0])
+
+
+class TrainLoader:
+    """AnchorLoader twin: shuffled, aspect-grouped, bucket-padded batches."""
+
+    def __init__(
+        self,
+        roidb: List[Dict],
+        cfg: Config,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        prefetch: int = 2,
+    ):
+        self.roidb = roidb
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.prefetch = prefetch
+        self.epoch = 0
+
+    def __len__(self) -> int:
+        return len(self.roidb) // self.batch_size
+
+    def _epoch_plan(self, epoch: int) -> List[Tuple[Tuple[int, int], List[int]]]:
+        """Group indices by orientation bucket, shuffle within groups,
+        emit whole batches (dropping the ragged tail like the reference's
+        ``pad`` handling drops/wraps)."""
+        rng = np.random.RandomState(self.seed + epoch)
+        groups: Dict[Tuple[int, int], List[int]] = {}
+        for i, rec in enumerate(self.roidb):
+            b = _orientation_bucket(rec, self.cfg.SHAPE_BUCKETS)
+            groups.setdefault(b, []).append(i)
+        plan = []
+        for b, idxs in groups.items():
+            idxs = np.asarray(idxs)
+            if self.shuffle:
+                rng.shuffle(idxs)
+            for s in range(0, len(idxs) - self.batch_size + 1, self.batch_size):
+                plan.append((b, idxs[s : s + self.batch_size].tolist()))
+        if self.shuffle:
+            order = rng.permutation(len(plan))
+            plan = [plan[i] for i in order]
+        return plan
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        plan = self._epoch_plan(self.epoch)
+        self.epoch += 1
+        if self.prefetch <= 0:
+            for bucket, idxs in plan:
+                yield make_batch([self.roidb[i] for i in idxs], self.cfg, bucket)
+            return
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = object()
+
+        def worker():
+            try:
+                for bucket, idxs in plan:
+                    q.put(make_batch([self.roidb[i] for i in idxs], self.cfg, bucket))
+            finally:
+                q.put(stop)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is stop:
+                break
+            yield item
+
+
+class TestLoader:
+    """batch=1 inference iterator (TestLoader twin); also yields the roidb
+    record so eval can undo the resize scale."""
+
+    def __init__(self, roidb: List[Dict], cfg: Config):
+        self.roidb = roidb
+        self.cfg = cfg
+
+    def __len__(self) -> int:
+        return len(self.roidb)
+
+    def __iter__(self):
+        for rec in self.roidb:
+            bucket = _orientation_bucket(rec, self.cfg.SHAPE_BUCKETS)
+            batch = make_batch([rec], self.cfg, bucket)
+            yield rec, batch

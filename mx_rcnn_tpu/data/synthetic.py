@@ -1,0 +1,104 @@
+"""Synthetic dataset: deterministic random images + boxes, no files.
+
+No reference twin — this is the rebuild's "fake backend" for tests,
+smoke-training, and benchmarking in environments without VOC/COCO on disk
+(SURVEY §5.1's do-better-cheaply test strategy).  Images are generated in
+memory with colored rectangles on noise so a detector can genuinely
+overfit them; boxes are the rectangle coordinates.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from mx_rcnn_tpu.data.imdb import IMDB
+
+
+def synthetic_image(rec: Dict, seed: int) -> np.ndarray:
+    """Render the record: noise background + filled class-colored boxes."""
+    rng = np.random.RandomState(seed)
+    h, w = rec["height"], rec["width"]
+    im = rng.rand(h, w, 3).astype(np.float32) * 60.0 + 90.0
+    for box, cls in zip(rec["boxes"], rec["gt_classes"]):
+        x1, y1, x2, y2 = box.astype(int)
+        color = np.array(
+            [50 + 40 * (cls % 5), 60 + 30 * (cls % 7), 70 + 25 * (cls % 3)],
+            np.float32,
+        )
+        im[y1 : y2 + 1, x1 : x2 + 1] = color + rng.rand(
+            y2 - y1 + 1, x2 - x1 + 1, 3
+        ).astype(np.float32) * 10.0
+    return im
+
+
+class SyntheticDataset(IMDB):
+    def __init__(
+        self,
+        num_images: int = 32,
+        num_classes: int = 21,
+        image_size=(480, 640),
+        max_boxes: int = 4,
+        seed: int = 0,
+    ):
+        super().__init__(f"synthetic_{num_images}", root_path="/tmp")
+        self.classes = ["__background__"] + [
+            f"class{i}" for i in range(1, num_classes)
+        ]
+        self.image_set_index = list(range(num_images))
+        self.seed = seed
+        self.image_size = image_size
+        self.max_boxes = max_boxes
+
+    def gt_roidb(self) -> List[Dict]:
+        rng = np.random.RandomState(self.seed)
+        h, w = self.image_size
+        roidb = []
+        for i in self.image_set_index:
+            n = rng.randint(1, self.max_boxes + 1)
+            boxes, classes = [], []
+            for _ in range(n):
+                bw = rng.randint(60, w // 2)
+                bh = rng.randint(60, h // 2)
+                x1 = rng.randint(0, w - bw)
+                y1 = rng.randint(0, h - bh)
+                boxes.append([x1, y1, x1 + bw - 1, y1 + bh - 1])
+                classes.append(rng.randint(1, self.num_classes))
+            roidb.append(
+                {
+                    "image": f"synthetic://{i}",
+                    "height": h,
+                    "width": w,
+                    "boxes": np.asarray(boxes, np.float32),
+                    "gt_classes": np.asarray(classes, np.int32),
+                    "flipped": False,
+                    "synthetic_seed": self.seed + 1000 + i,
+                }
+            )
+        return roidb
+
+    def evaluate_detections(self, detections, **kw):
+        """VOC-style mAP against the synthetic gt (integral metric)."""
+        from mx_rcnn_tpu.eval.voc_eval import voc_eval
+
+        roidb = self.gt_roidb()
+        annots = {
+            i: {"boxes": r["boxes"], "gt_classes": r["gt_classes"]}
+            for i, r in enumerate(roidb)
+        }
+        aps = {}
+        for cls_idx in range(1, self.num_classes):
+            # classes absent from the gt have undefined AP and are skipped;
+            # classes WITH gt but no detections score 0 (they must count
+            # against mAP or a near-blind model would look good)
+            if not any((r["gt_classes"] == cls_idx).any() for r in roidb):
+                continue
+            dets_by_img = {
+                i: detections[cls_idx][i] for i in range(len(roidb))
+            }
+            _, _, ap = voc_eval(dets_by_img, annots, cls_idx, 0.5, False)
+            aps[f"class{cls_idx}"] = ap
+        vals = [v for v in aps.values()]
+        aps["mAP"] = float(np.mean(vals)) if vals else 0.0
+        return aps

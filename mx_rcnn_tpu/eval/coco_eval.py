@@ -1,0 +1,199 @@
+"""COCO bbox evaluation protocol in pure numpy.
+
+Reference: the vendored ``rcnn/pycocotools/cocoeval.py :: COCOeval``
+(evaluate/accumulate/summarize) — reimplemented from the published
+protocol because this environment has no pycocotools wheel and the
+vendored copy may not be copied (SURVEY N5).  Faithful to the protocol:
+
+- 10 IoU thresholds 0.50:0.05:0.95, 101 recall points,
+- area ranges all/small/medium/large, maxDets 1/10/100,
+- greedy score-descending matching, crowd gts as ignore regions with
+  intersection-over-det-area IoU, unmatched dets on ignored gt ignored,
+- 12 summary statistics in the standard order.
+
+Mask (segm) evaluation is out of scope here; the native RLE mask API
+lives in ``mx_rcnn_tpu/native`` for the Mask R-CNN extension.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+IOU_THRS = np.linspace(0.5, 0.95, 10)
+REC_THRS = np.linspace(0.0, 1.0, 101)
+AREA_RNGS = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0**2),
+    "medium": (32.0**2, 96.0**2),
+    "large": (96.0**2, 1e10),
+}
+MAX_DETS = (1, 10, 100)
+
+
+def _iou_xywh(dets: np.ndarray, gts: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
+    """IoU between (D, 4) and (G, 4) xywh boxes; crowd gt → inter/det_area."""
+    if len(dets) == 0 or len(gts) == 0:
+        return np.zeros((len(dets), len(gts)))
+    dx1, dy1 = dets[:, 0], dets[:, 1]
+    dx2, dy2 = dets[:, 0] + dets[:, 2], dets[:, 1] + dets[:, 3]
+    gx1, gy1 = gts[:, 0], gts[:, 1]
+    gx2, gy2 = gts[:, 0] + gts[:, 2], gts[:, 1] + gts[:, 3]
+    iw = np.minimum(dx2[:, None], gx2[None, :]) - np.maximum(dx1[:, None], gx1[None, :])
+    ih = np.minimum(dy2[:, None], gy2[None, :]) - np.maximum(dy1[:, None], gy1[None, :])
+    inter = np.clip(iw, 0, None) * np.clip(ih, 0, None)
+    d_area = (dets[:, 2] * dets[:, 3])[:, None]
+    g_area = (gts[:, 2] * gts[:, 3])[None, :]
+    union = np.where(iscrowd[None, :], d_area, d_area + g_area - inter)
+    return inter / np.maximum(union, 1e-12)
+
+
+class COCOEvalBbox:
+    def __init__(self, dataset: Dict, results: List[Dict]):
+        """``dataset``: the loaded instances json (images/annotations/
+        categories); ``results``: list of {image_id, category_id, bbox
+        (xywh), score} detection dicts."""
+        self.img_ids = sorted({im["id"] for im in dataset["images"]})
+        self.cat_ids = sorted({c["id"] for c in dataset["categories"]})
+        self._gts: Dict = {(i, c): [] for i in self.img_ids for c in self.cat_ids}
+        for ann in dataset["annotations"]:
+            key = (ann["image_id"], ann["category_id"])
+            if key in self._gts:
+                self._gts[key].append(ann)
+        self._dts: Dict = {(i, c): [] for i in self.img_ids for c in self.cat_ids}
+        for det in results:
+            key = (det["image_id"], det["category_id"])
+            if key in self._dts:
+                self._dts[key].append(det)
+
+    def _evaluate_img(self, img_id, cat_id, area_rng, max_det):
+        gts = self._gts[(img_id, cat_id)]
+        dts = sorted(
+            self._dts[(img_id, cat_id)], key=lambda d: -d["score"]
+        )[:max_det]
+        if not gts and not dts:
+            return None
+
+        g_boxes = np.array([g["bbox"] for g in gts]).reshape(-1, 4)
+        g_crowd = np.array([g.get("iscrowd", 0) for g in gts], bool)
+        g_area = np.array(
+            [g.get("area", g["bbox"][2] * g["bbox"][3]) for g in gts]
+        )
+        g_ignore = g_crowd | (g_area < area_rng[0]) | (g_area > area_rng[1])
+        # sort gts: non-ignored first (protocol requirement)
+        g_order = np.argsort(g_ignore, kind="stable")
+        g_boxes, g_crowd, g_ignore = g_boxes[g_order], g_crowd[g_order], g_ignore[g_order]
+
+        d_boxes = np.array([d["bbox"] for d in dts]).reshape(-1, 4)
+        d_scores = np.array([d["score"] for d in dts])
+        ious = _iou_xywh(d_boxes, g_boxes, g_crowd)
+
+        T, D, G = len(IOU_THRS), len(dts), len(gts)
+        dt_m = -np.ones((T, D), int)
+        gt_m = -np.ones((T, G), int)
+        dt_ig = np.zeros((T, D), bool)
+        for ti, t in enumerate(IOU_THRS):
+            for di in range(D):
+                best_iou = min(t, 1 - 1e-10)
+                best_g = -1
+                for gi in range(G):
+                    if gt_m[ti, gi] >= 0 and not g_crowd[gi]:
+                        continue  # taken (crowd can absorb many dets)
+                    # stop at ignored gts once a non-ignored match exists
+                    if best_g >= 0 and not g_ignore[best_g] and g_ignore[gi]:
+                        break
+                    if ious[di, gi] < best_iou:
+                        continue
+                    best_iou = ious[di, gi]
+                    best_g = gi
+                if best_g >= 0:
+                    dt_m[ti, di] = best_g
+                    gt_m[ti, best_g] = di
+                    dt_ig[ti, di] = g_ignore[best_g]
+        # unmatched dets outside the area range are ignored
+        d_area = d_boxes[:, 2] * d_boxes[:, 3]
+        d_out = (d_area < area_rng[0]) | (d_area > area_rng[1])
+        dt_ig |= (dt_m == -1) & d_out[None, :]
+        return {
+            "dt_matches": dt_m,
+            "dt_scores": d_scores,
+            "dt_ignore": dt_ig,
+            "gt_ignore": g_ignore,
+            "num_gt": int((~g_ignore).sum()),
+        }
+
+    def _accumulate(self, area_rng, max_det):
+        """→ precision (T, R, K), recall (T, K) over categories K."""
+        T, R, K = len(IOU_THRS), len(REC_THRS), len(self.cat_ids)
+        precision = -np.ones((T, R, K))
+        recall = -np.ones((T, K))
+        for ki, cat_id in enumerate(self.cat_ids):
+            evals = [
+                self._evaluate_img(i, cat_id, area_rng, max_det)
+                for i in self.img_ids
+            ]
+            evals = [e for e in evals if e is not None]
+            if not evals:
+                continue
+            scores = np.concatenate([e["dt_scores"] for e in evals])
+            order = np.argsort(-scores, kind="mergesort")
+            dt_m = np.concatenate([e["dt_matches"] for e in evals], axis=1)[:, order]
+            dt_ig = np.concatenate([e["dt_ignore"] for e in evals], axis=1)[:, order]
+            npig = sum(e["num_gt"] for e in evals)
+            if npig == 0:
+                continue
+            tps = (dt_m >= 0) & ~dt_ig
+            fps = (dt_m == -1) & ~dt_ig
+            tp_sum = np.cumsum(tps, axis=1).astype(float)
+            fp_sum = np.cumsum(fps, axis=1).astype(float)
+            for ti in range(T):
+                tp, fp = tp_sum[ti], fp_sum[ti]
+                nd = len(tp)
+                rc = tp / npig
+                pr = tp / np.maximum(tp + fp, np.finfo(np.float64).eps)
+                recall[ti, ki] = rc[-1] if nd else 0.0
+                # precision envelope (monotone decreasing)
+                q = np.zeros(R)
+                pr = pr.tolist()
+                for i in range(nd - 1, 0, -1):
+                    if pr[i] > pr[i - 1]:
+                        pr[i - 1] = pr[i]
+                inds = np.searchsorted(rc, REC_THRS, side="left")
+                for ri, pi in enumerate(inds):
+                    if pi < nd:
+                        q[ri] = pr[pi]
+                precision[ti, :, ki] = q
+        return precision, recall
+
+    @staticmethod
+    def _mean_valid(x: np.ndarray) -> float:
+        valid = x[x > -1]
+        return float(np.mean(valid)) if valid.size else -1.0
+
+    def evaluate(self, verbose: bool = True) -> Dict[str, float]:
+        """Run the full protocol; returns the 12 standard stats."""
+        cache: Dict = {}
+
+        def acc(name: str, md: int):
+            key = (name, md)
+            if key not in cache:
+                cache[key] = self._accumulate(AREA_RNGS[name], md)
+            return cache[key]
+
+        p_all, r_all = acc("all", 100)
+        stats = {
+            "AP": self._mean_valid(p_all),
+            "AP50": self._mean_valid(p_all[np.isclose(IOU_THRS, 0.5)]),
+            "AP75": self._mean_valid(p_all[np.isclose(IOU_THRS, 0.75)]),
+        }
+        for name in ("small", "medium", "large"):
+            stats[f"AP_{name}"] = self._mean_valid(acc(name, 100)[0])
+        for md in MAX_DETS:
+            stats[f"AR_{md}"] = self._mean_valid(acc("all", md)[1])
+        for name in ("small", "medium", "large"):
+            stats[f"AR_{name}"] = self._mean_valid(acc(name, 100)[1])
+        if verbose:
+            for k, v in stats.items():
+                print(f" {k:<10s} = {v:.3f}")
+        return stats

@@ -1,0 +1,240 @@
+"""Faster R-CNN: one Flax module, one jitted graph, zero host round-trips.
+
+Reference: the train/test Symbol builders ``rcnn/symbol/symbol_vgg.py ::
+get_vgg_train/test`` and ``symbol_resnet.py :: get_resnet_train/test``
+(SURVEY §4.5) — but where the reference graph hops to Python twice per
+step (proposal + proposal_target CustomOps), here the proposal layer,
+anchor-target assignment, and roi sampling are all jnp inside the same
+XLA program.  Anchors are a trace-time constant derived from the (static,
+bucketed) feature shape — the reference needed ``feat_sym.infer_shape``
+machinery for the same purpose (``rcnn/core/loader.py :: AnchorLoader``).
+
+Train call returns (losses, aux-for-metrics); test call returns padded
+detections inputs (rois, class probs, de-normalized deltas).  Bbox-target
+normalization stays in the loss/test-path (never folded into weights —
+SURVEY §5.5 explains the reference's checkpoint quirk we deliberately
+avoid).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.models.heads import MaskHead, RCNNHead
+from mx_rcnn_tpu.models.resnet import ResNetBackbone, ResNetTopHead
+from mx_rcnn_tpu.models.rpn import RPNHead
+from mx_rcnn_tpu.models.vgg import VGGBackbone, VGGTopHead
+from mx_rcnn_tpu.ops.anchors import shifted_anchors
+from mx_rcnn_tpu.ops.losses import (
+    accuracy,
+    smooth_l1,
+    softmax_cross_entropy,
+    weighted_smooth_l1,
+)
+from mx_rcnn_tpu.ops.proposal import propose
+from mx_rcnn_tpu.ops.roi_align import extract_roi_features
+from mx_rcnn_tpu.ops.targets import assign_anchor, sample_rois
+
+
+def _dtype_of(cfg: Config):
+    return jnp.bfloat16 if cfg.network.COMPUTE_DTYPE == "bfloat16" else jnp.float32
+
+
+class FasterRCNN(nn.Module):
+    """Two-stage detector over a single-level feature map (VGG / ResNet-C4)."""
+
+    cfg: Config
+
+    def setup(self):
+        cfg = self.cfg
+        dtype = _dtype_of(cfg)
+        if cfg.network.name == "vgg":
+            self.backbone = VGGBackbone(dtype=dtype)
+            self.top_head = VGGTopHead(dtype=dtype)
+            rpn_in = 512
+        else:
+            self.backbone = ResNetBackbone(depth=cfg.network.depth, dtype=dtype)
+            self.top_head = ResNetTopHead(depth=cfg.network.depth, dtype=dtype)
+            rpn_in = 512
+        self.rpn = RPNHead(
+            num_anchors=cfg.network.NUM_ANCHORS, channels=rpn_in, dtype=dtype
+        )
+        self.rcnn = RCNNHead(num_classes=cfg.dataset.NUM_CLASSES, dtype=dtype)
+        if cfg.network.USE_MASK:
+            self.mask_head = MaskHead(num_classes=cfg.dataset.NUM_CLASSES, dtype=dtype)
+
+    def _anchors(self, feat_h: int, feat_w: int) -> jnp.ndarray:
+        net = self.cfg.network
+        return jnp.asarray(
+            shifted_anchors(
+                feat_h,
+                feat_w,
+                net.RPN_FEAT_STRIDE,
+                ratios=net.ANCHOR_RATIOS,
+                scales=net.ANCHOR_SCALES,
+            )
+        )
+
+    def _roi_features(self, feat: jnp.ndarray, rois: jnp.ndarray) -> jnp.ndarray:
+        """(B, Hf, Wf, C) × (B, R, 4) → (B*R, D) head trunk features."""
+        net = self.cfg.network
+        pooled = jax.vmap(
+            lambda f, r: extract_roi_features(
+                f,
+                r,
+                net.ROI_MODE,
+                net.POOLED_SIZE,
+                1.0 / net.RCNN_FEAT_STRIDE,
+                net.ROI_SAMPLE_RATIO,
+            )
+        )(feat, rois)
+        b, r = pooled.shape[0], pooled.shape[1]
+        return self.top_head(pooled.reshape((b * r,) + pooled.shape[2:]))
+
+    def __call__(
+        self,
+        images: jnp.ndarray,
+        im_info: jnp.ndarray,
+        gt_boxes: Optional[jnp.ndarray] = None,
+        gt_valid: Optional[jnp.ndarray] = None,
+        train: bool = False,
+    ):
+        if train:
+            return self.train_forward(images, im_info, gt_boxes, gt_valid)
+        return self.test_forward(images, im_info)
+
+    # ------------------------------------------------------------------ train
+    def train_forward(
+        self,
+        images: jnp.ndarray,
+        im_info: jnp.ndarray,
+        gt_boxes: jnp.ndarray,
+        gt_valid: jnp.ndarray,
+    ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        cfg = self.cfg
+        t = cfg.TRAIN
+        b = images.shape[0]
+
+        feat = self.backbone(images)
+        rpn_logits, rpn_deltas = self.rpn(feat)           # (B, N, 2/4)
+        anchors = self._anchors(feat.shape[1], feat.shape[2])
+
+        key = self.make_rng("sampling")
+        keys = jax.random.split(key, (b, 2))
+
+        # --- RPN anchor targets (reference: rcnn/io/rpn.py :: assign_anchor)
+        atgt = jax.vmap(
+            lambda gtb, gtv, info, k: assign_anchor(anchors, gtb[:, :4], gtv, info, k, cfg)
+        )(gt_boxes, gt_valid, im_info, keys[:, 0])
+
+        # --- proposals (stop-gradient: reference proposal op has no backward)
+        fg_scores = jax.nn.softmax(rpn_logits, axis=-1)[..., 1]
+        props = jax.vmap(
+            lambda s, d, info: propose(
+                s,
+                d,
+                anchors,
+                info,
+                t.RPN_PRE_NMS_TOP_N,
+                t.RPN_POST_NMS_TOP_N,
+                t.RPN_NMS_THRESH,
+                t.RPN_MIN_SIZE,
+            )
+        )(jax.lax.stop_gradient(fg_scores), jax.lax.stop_gradient(rpn_deltas), im_info)
+
+        # --- sample rois + RCNN targets (reference: proposal_target CustomOp)
+        samples = jax.vmap(
+            lambda r, rv, gtb, gtv, k: sample_rois(r, rv, gtb, gtv, k, cfg)
+        )(props.rois, props.valid, gt_boxes, gt_valid, keys[:, 1])
+
+        # --- second stage
+        trunk = self._roi_features(feat, samples.rois)     # (B*R, D)
+        cls_logits, bbox_pred_out = self.rcnn(trunk)       # (B*R, K), (B*R, 4K)
+
+        labels = samples.labels.reshape(-1)
+        bbox_targets = samples.bbox_targets.reshape(bbox_pred_out.shape)
+        bbox_weights = samples.bbox_weights.reshape(bbox_pred_out.shape)
+
+        # --- losses, reference normalization semantics (SURVEY §4.5)
+        rpn_norm = float(t.RPN_BATCH_SIZE * b)
+        rcnn_norm = float(t.BATCH_ROIS * b)
+        rpn_cls_loss = softmax_cross_entropy(
+            rpn_logits.reshape(-1, 2), atgt.labels.reshape(-1), -1, rpn_norm
+        )
+        rpn_bbox_loss = weighted_smooth_l1(
+            rpn_deltas.reshape(-1, 4),
+            atgt.bbox_targets.reshape(-1, 4),
+            atgt.bbox_weights.reshape(-1, 4),
+            sigma=3.0,
+            norm=rpn_norm,
+        )
+        rcnn_cls_loss = softmax_cross_entropy(cls_logits, labels, -1, rcnn_norm)
+        rcnn_bbox_loss = weighted_smooth_l1(
+            bbox_pred_out, bbox_targets, bbox_weights, sigma=1.0, norm=rcnn_norm
+        )
+        total = rpn_cls_loss + rpn_bbox_loss + rcnn_cls_loss + rcnn_bbox_loss
+
+        aux = {
+            # the reference's six metrics (rcnn/core/metric.py), same names
+            "RPNAcc": accuracy(rpn_logits.reshape(-1, 2), atgt.labels.reshape(-1)),
+            "RPNLogLoss": rpn_cls_loss,
+            "RPNL1Loss": rpn_bbox_loss,
+            "RCNNAcc": accuracy(cls_logits, labels),
+            "RCNNLogLoss": rcnn_cls_loss,
+            "RCNNL1Loss": rcnn_bbox_loss,
+            "num_fg_rois": (labels > 0).sum(),
+            "num_valid_props": props.valid.sum(),
+        }
+        return total, aux
+
+    # ------------------------------------------------------------------- test
+    def test_forward(self, images: jnp.ndarray, im_info: jnp.ndarray):
+        """→ dict with padded per-image rois, class probs, decoded deltas.
+
+        Mirrors ``get_*_test`` + the head of ``rcnn/core/tester.py ::
+        im_detect``: proposals from the RPN, class posteriors, and
+        *de-normalized* class-specific deltas (the reference baked the
+        de-normalization into saved weights; we keep it explicit here).
+        """
+        cfg = self.cfg
+        te = cfg.TEST
+        feat = self.backbone(images)
+        rpn_logits, rpn_deltas = self.rpn(feat)
+        anchors = self._anchors(feat.shape[1], feat.shape[2])
+
+        fg_scores = jax.nn.softmax(rpn_logits, axis=-1)[..., 1]
+        props = jax.vmap(
+            lambda s, d, info: propose(
+                s,
+                d,
+                anchors,
+                info,
+                te.RPN_PRE_NMS_TOP_N,
+                te.RPN_POST_NMS_TOP_N,
+                te.RPN_NMS_THRESH,
+                te.RPN_MIN_SIZE,
+            )
+        )(fg_scores, rpn_deltas, im_info)
+
+        trunk = self._roi_features(feat, props.rois)
+        cls_logits, bbox_deltas = self.rcnn(trunk)
+        b, r = images.shape[0], te.RPN_POST_NMS_TOP_N
+        k = cfg.dataset.NUM_CLASSES
+
+        means = jnp.tile(jnp.asarray(cfg.TRAIN.BBOX_MEANS, jnp.float32), k)
+        stds = jnp.tile(jnp.asarray(cfg.TRAIN.BBOX_STDS, jnp.float32), k)
+        bbox_deltas = bbox_deltas * stds[None, :] + means[None, :]
+
+        return {
+            "rois": props.rois,                                  # (B, R, 4)
+            "roi_scores": props.scores,                          # (B, R)
+            "roi_valid": props.valid,                            # (B, R)
+            "cls_prob": jax.nn.softmax(cls_logits).reshape(b, r, k),
+            "bbox_deltas": bbox_deltas.reshape(b, r, 4 * k),
+        }

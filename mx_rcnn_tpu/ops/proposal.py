@@ -1,0 +1,64 @@
+"""Proposal generation: RPN outputs → fixed-size roi set, fully in-graph.
+
+Reference: ``rcnn/symbol/proposal.py :: ProposalOperator.forward`` — a
+host-side CustomOp that copies RPN outputs to CPU every step, decodes with
+numpy, calls the CUDA NMS, and copies rois back (boundary B1 in SURVEY
+§4.1).  Here the whole thing is jnp inside the train/test jit: decode →
+clip → min-size mask → top-k → masked NMS → pad to POST_NMS_TOP_N.  The
+reference already padded its output to a fixed size; we extend that
+discipline with an explicit validity mask instead of its zero-row hack.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
+from mx_rcnn_tpu.ops.nms import nms
+
+_NEG_INF = -1e10
+
+
+class Proposals(NamedTuple):
+    rois: jnp.ndarray    # (POST_NMS, 4) image-coordinate boxes, padded
+    scores: jnp.ndarray  # (POST_NMS,)
+    valid: jnp.ndarray   # (POST_NMS,) bool
+
+
+def propose(
+    fg_scores: jnp.ndarray,
+    deltas: jnp.ndarray,
+    anchors: jnp.ndarray,
+    im_info: jnp.ndarray,
+    pre_nms_top_n: int,
+    post_nms_top_n: int,
+    nms_thresh: float,
+    min_size: float,
+) -> Proposals:
+    """One image: (N,) anchor fg scores + (N, 4) deltas → proposals.
+
+    ``im_info`` = (h, w, scale) of the unpadded image; ``min_size`` is
+    scaled by ``im_info[2]`` exactly as the reference does.
+    """
+    h, w, scale = im_info[0], im_info[1], im_info[2]
+    boxes = bbox_pred(anchors, deltas)
+    boxes = clip_boxes(boxes, (h, w))
+
+    ms = min_size * scale
+    ws = boxes[:, 2] - boxes[:, 0] + 1.0
+    hs = boxes[:, 3] - boxes[:, 1] + 1.0
+    keep = (ws >= ms) & (hs >= ms)
+
+    scores = jnp.where(keep, fg_scores, _NEG_INF)
+    k = min(pre_nms_top_n, scores.shape[0])
+    top_scores, idx = jax.lax.top_k(scores, k)
+    top_boxes = boxes[idx]
+    top_valid = top_scores > _NEG_INF / 2
+
+    out_boxes, out_scores, out_valid = nms(
+        top_boxes, top_scores, nms_thresh, post_nms_top_n, top_valid
+    )
+    return Proposals(out_boxes, out_scores, out_valid)

@@ -1,0 +1,6 @@
+from mx_rcnn_tpu.parallel.mesh import (
+    make_mesh,
+    make_parallel_train_step,
+    replicate,
+    shard_batch,
+)

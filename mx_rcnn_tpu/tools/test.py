@@ -1,0 +1,90 @@
+"""Evaluation CLI: mAP on VOC/COCO (or synthetic).
+
+Reference: ``test.py`` + ``rcnn/tools/test_rcnn.py`` — build the test
+graph, run ``pred_eval`` over the test set, print the mAP table /
+COCOeval summary.
+
+Example:
+  python -m mx_rcnn_tpu.tools.test --network resnet --dataset PascalVOC \
+      --prefix model/e2e --epoch 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import jax
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.checkpoint import latest_epoch, load_checkpoint
+from mx_rcnn_tpu.core.tester import Predictor, pred_eval
+from mx_rcnn_tpu.core.train import create_train_state, make_optimizer
+from mx_rcnn_tpu.data.loader import TestLoader
+from mx_rcnn_tpu.models import FasterRCNN
+from mx_rcnn_tpu.utils.load_data import get_imdb
+
+logger = logging.getLogger(__name__)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="Evaluate Faster R-CNN")
+    p.add_argument("--network", default="resnet",
+                   choices=["vgg", "resnet", "resnet50", "resnet_fpn", "mask_resnet_fpn"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "PascalVOC0712", "coco"])
+    p.add_argument("--image_set", default=None, help="defaults to the test split")
+    p.add_argument("--prefix", default="model/e2e")
+    p.add_argument("--epoch", type=int, default=None, help="default: latest")
+    p.add_argument("--thresh", type=float, default=None)
+    p.add_argument("--synthetic", type=int, default=0)
+    p.add_argument("--max_images", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def test_rcnn(args):
+    cfg = generate_config(args.network, args.dataset)
+    imdbs = get_imdb(
+        cfg, args.image_set or cfg.dataset.test_image_set, args.synthetic
+    )
+    imdb = imdbs[0]
+    roidb = imdb.gt_roidb()
+    if args.max_images:
+        # truncate the imdb's index too: evaluate_detections iterates it
+        roidb = roidb[: args.max_images]
+        imdb.image_set_index = imdb.image_set_index[: args.max_images]
+
+    model = FasterRCNN(cfg)
+    import numpy as np
+
+    h, w = cfg.SHAPE_BUCKETS[0]
+    params = model.init(
+        {"params": jax.random.key(0)},
+        np.zeros((1, h, w, 3), np.float32),
+        np.array([[h, w, 1.0]], np.float32),
+        train=False,
+    )["params"]
+    epoch = args.epoch if args.epoch is not None else latest_epoch(args.prefix)
+    if epoch is not None:
+        tx = make_optimizer(cfg, lambda s: 0.0)
+        state = load_checkpoint(args.prefix, epoch, create_train_state(params, tx))
+        params = state.params
+        logger.info("loaded checkpoint epoch %d", epoch)
+    else:
+        logger.warning("no checkpoint found at %s — evaluating random init", args.prefix)
+
+    predictor = Predictor(model, params)
+    loader = TestLoader(roidb, cfg)
+    _, results = pred_eval(predictor, loader, imdb, cfg, thresh=args.thresh)
+    for k, v in results.items():
+        logger.info("%s: %.4f", k, v)
+    return results
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, force=True)
+    test_rcnn(parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""End-to-end Faster R-CNN training CLI.
+
+Reference: ``train_end2end.py`` (argparse → generate_config → roidb →
+AnchorLoader → MutableModule.fit with SGD/MultiFactorScheduler,
+kvstore='device').  Same flow, TPU-native pieces: TrainLoader →
+shard_map DP train step → Orbax checkpoints.
+
+Example:
+  python -m mx_rcnn_tpu.tools.train_end2end --network resnet \
+      --dataset PascalVOC --synthetic 64 --epochs 2 --prefix model/e2e
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.checkpoint import latest_epoch, load_checkpoint, save_checkpoint
+from mx_rcnn_tpu.core.metrics import MetricTracker, Speedometer
+from mx_rcnn_tpu.core.train import (
+    create_train_state,
+    make_lr_schedule,
+    make_optimizer,
+    make_train_step,
+)
+from mx_rcnn_tpu.data.loader import TrainLoader
+from mx_rcnn_tpu.models import FasterRCNN
+from mx_rcnn_tpu.parallel import (
+    make_mesh,
+    make_parallel_train_step,
+    replicate,
+    shard_batch,
+)
+from mx_rcnn_tpu.utils.load_data import load_gt_roidb
+
+logger = logging.getLogger(__name__)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="Train Faster R-CNN end-to-end")
+    p.add_argument("--network", default="resnet",
+                   choices=["vgg", "resnet", "resnet50", "resnet_fpn", "mask_resnet_fpn"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "PascalVOC0712", "coco"])
+    p.add_argument("--image_set", default=None)
+    p.add_argument("--prefix", default="model/e2e", help="checkpoint dir")
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--batch_images", type=int, default=None, help="per-chip batch")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--no_flip", action="store_true")
+    p.add_argument("--no_shuffle", action="store_true")
+    p.add_argument("--frequent", type=int, default=20, help="logging interval")
+    p.add_argument("--synthetic", type=int, default=0,
+                   help="train on N synthetic images (no dataset needed)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max_steps", type=int, default=0,
+                   help="stop after N steps (smoke runs)")
+    p.add_argument("--cpu", type=int, default=0, metavar="N",
+                   help="force the host backend with N virtual devices")
+    return p.parse_args(argv)
+
+
+def train_net(args):
+    import dataclasses
+
+    if args.cpu:
+        from mx_rcnn_tpu.utils.platform import force_cpu
+
+        force_cpu(args.cpu)
+
+    cfg = generate_config(args.network, args.dataset)
+    overrides = {}
+    if args.lr is not None:
+        overrides["LEARNING_RATE"] = args.lr
+    if args.batch_images is not None:
+        overrides["BATCH_IMAGES"] = args.batch_images
+    if overrides:
+        cfg = cfg.replace(TRAIN=dataclasses.replace(cfg.TRAIN, **overrides))
+
+    n_chips = len(jax.devices())
+    per_chip = cfg.TRAIN.BATCH_IMAGES
+    global_batch = per_chip * n_chips
+    logger.info("devices=%d per_chip_batch=%d global_batch=%d",
+                n_chips, per_chip, global_batch)
+
+    _, roidb = load_gt_roidb(
+        cfg,
+        args.image_set,
+        flip=cfg.TRAIN.FLIP and not args.no_flip,
+        synthetic_size=args.synthetic,
+    )
+    logger.info("roidb size: %d", len(roidb))
+    loader = TrainLoader(
+        roidb, cfg, global_batch,
+        shuffle=cfg.TRAIN.SHUFFLE and not args.no_shuffle, seed=args.seed,
+    )
+    steps_per_epoch = max(len(loader), 1)
+
+    model = FasterRCNN(cfg)
+    h, w = cfg.SHAPE_BUCKETS[0]
+    init_batch = {
+        "images": np.zeros((1, h, w, 3), np.float32),
+        "im_info": np.array([[h, w, 1.0]], np.float32),
+        "gt_boxes": np.zeros((1, cfg.dataset.MAX_GT_BOXES, 5), np.float32),
+        "gt_valid": np.zeros((1, cfg.dataset.MAX_GT_BOXES), bool),
+    }
+    params = model.init(
+        {"params": jax.random.key(args.seed), "sampling": jax.random.key(1)},
+        init_batch["images"], init_batch["im_info"],
+        init_batch["gt_boxes"], init_batch["gt_valid"], train=True,
+    )["params"]
+
+    tx = make_optimizer(cfg, make_lr_schedule(cfg, steps_per_epoch))
+    state = create_train_state(params, tx)
+    begin_epoch = 0
+    if args.resume:
+        last = latest_epoch(args.prefix)
+        if last is not None:
+            state = load_checkpoint(args.prefix, last, state)
+            begin_epoch = last
+            logger.info("resumed from epoch %d", last)
+
+    use_mesh = n_chips > 1
+    if use_mesh:
+        mesh = make_mesh(n_data=n_chips, n_model=1)
+        state = replicate(state, mesh)
+        step_fn = make_parallel_train_step(model, tx, mesh)
+    else:
+        step_fn = make_train_step(model, tx)
+
+    tracker = MetricTracker()
+    speedo = Speedometer(global_batch, args.frequent)
+    rng = jax.random.key(args.seed + 123)
+    total_steps = 0
+    for epoch in range(begin_epoch, args.epochs):
+        for batch in loader:
+            if use_mesh:
+                batch = shard_batch(batch, mesh)
+            state, aux = step_fn(state, batch, rng)
+            tracker.update({k: float(v) for k, v in jax.device_get(aux).items()})
+            total_steps += 1
+            speedo(epoch, total_steps, tracker)
+            if args.max_steps and total_steps >= args.max_steps:
+                break
+        path = save_checkpoint(args.prefix, jax.device_get(state), epoch + 1)
+        logger.info("Epoch[%d] checkpoint -> %s", epoch, path)
+        if args.max_steps and total_steps >= args.max_steps:
+            break
+    return state
+
+
+def main():
+    # force=True: jax/absl pre-install a root handler at WARNING, which
+    # would silently swallow these INFO logs
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        force=True,
+    )
+    train_net(parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Dataset factory + roidb assembly.
+
+Reference: ``rcnn/utils/load_data.py`` (``load_gt_roidb`` /
+``load_proposal_roidb`` / ``merge_roidb`` / ``filter_roidb``) and the
+dataset selection switch in the entry points.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.data.imdb import IMDB, filter_roidb, merge_roidbs
+
+
+def get_imdb(cfg: Config, image_set: Optional[str] = None, synthetic_size: int = 0) -> List[IMDB]:
+    """Instantiate the dataset(s) named by the config.  '+'-joined image
+    sets (07+12 training) return multiple imdbs whose roidbs get merged."""
+    ds = cfg.dataset
+    if synthetic_size:
+        from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+
+        return [
+            SyntheticDataset(num_images=synthetic_size, num_classes=ds.NUM_CLASSES)
+        ]
+    image_set = image_set or ds.image_set
+    imdbs = []
+    for split in image_set.split("+"):
+        if ds.name == "PascalVOC":
+            from mx_rcnn_tpu.data.pascal_voc import PascalVOC
+
+            imdbs.append(PascalVOC(split, ds.root_path, ds.dataset_path))
+        elif ds.name == "coco":
+            from mx_rcnn_tpu.data.coco import COCO
+
+            imdbs.append(COCO(split, ds.root_path, ds.dataset_path))
+        else:
+            raise ValueError(f"unknown dataset {ds.name!r}")
+    return imdbs
+
+
+def load_gt_roidb(
+    cfg: Config,
+    image_set: Optional[str] = None,
+    flip: bool = False,
+    synthetic_size: int = 0,
+):
+    """gt roidb across image sets, optionally with flipped augmentation,
+    always filtered of empty images (reference: load_gt_roidb+filter)."""
+    imdbs = get_imdb(cfg, image_set, synthetic_size)
+    roidbs = [imdb.gt_roidb() for imdb in imdbs]
+    roidb = merge_roidbs(roidbs)
+    if flip:
+        roidb = IMDB.append_flipped_images(roidb)
+    return imdbs, filter_roidb(roidb)
+
+
+def load_proposal_roidb(roidb, proposal_path: str, top_n: int = 0):
+    """Attach dumped RPN proposals to a gt roidb for Fast-RCNN training
+    (reference: ``load_proposal_roidb`` reading the ``.pkl`` dumps)."""
+    with open(proposal_path, "rb") as f:
+        proposals = pickle.load(f)
+    assert len(proposals) == len(roidb), "proposal dump / roidb mismatch"
+    out = []
+    for rec, props in zip(roidb, proposals):
+        rec = dict(rec)
+        boxes = props[:, :4] if top_n <= 0 else props[:top_n, :4]
+        rec["proposals"] = boxes.astype("float32")
+        out.append(rec)
+    return out

@@ -1,0 +1,49 @@
+"""Backend selection helpers.
+
+This image's sitecustomize registers the axon TPU PJRT plugin and pins
+``jax_platforms`` at interpreter start, so the usual ``JAX_PLATFORMS=cpu``
+env var silently does nothing.  These helpers force the host backend (with
+N virtual devices) through jax.config, for tests/smoke runs on machines
+whose TPU is busy or absent.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(path: str = "/tmp/jax_cache") -> None:
+    """Persistent XLA compilation cache — first compiles of the big train
+    graphs take minutes (especially through the axon remote-compile
+    tunnel); every later process reuses them."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def force_cpu(n_devices: int = 1) -> None:
+    """Switch JAX to the host CPU backend with ``n_devices`` virtual
+    devices.  Must run before the first backend initialization in this
+    process (XLA parses XLA_FLAGS exactly once, at first client init)."""
+    import jax
+    from jax._src import xla_bridge as xb
+
+    if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        )
+    jax.config.update("jax_platforms", "cpu")
+    if xb.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    got = len(jax.devices())
+    if got < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} host devices, got {got} — set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices} "
+            f"before any jax use"
+        )

@@ -2,15 +2,37 @@
 
 This is the TPU-world "fake backend" the reference never had (SURVEY §5.1):
 multi-chip sharding paths compile and execute on 8 XLA host devices, so DP
-correctness is tested without hardware.  Must run before jax is imported.
+correctness is tested without hardware.
+
+Note: this environment's sitecustomize registers the axon TPU plugin and
+hard-sets ``jax_platforms`` at interpreter start (before conftest), so
+plain ``JAX_PLATFORMS=cpu`` is ignored — we must override via jax.config
+and drop any already-initialized backends.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+# persistent compile cache: recompiles across test runs are the dominant
+# cost on this 1-core machine
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+except Exception:  # pragma: no cover - backends not initialized yet
+    pass
+
+assert jax.devices()[0].platform == "cpu", "tests must run on host CPU"
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
 
 import numpy as np
 import pytest

@@ -1,0 +1,156 @@
+"""Tests for VOC AP and the pure-numpy COCO bbox protocol."""
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.eval.coco_eval import COCOEvalBbox
+from mx_rcnn_tpu.eval.voc_eval import voc_ap, voc_eval
+
+
+class TestVocAp:
+    def test_perfect_pr(self):
+        rec = np.array([0.5, 1.0])
+        prec = np.array([1.0, 1.0])
+        assert voc_ap(rec, prec, use_07_metric=False) == pytest.approx(1.0)
+        assert voc_ap(rec, prec, use_07_metric=True) == pytest.approx(1.0)
+
+    def test_known_07_value(self):
+        # single det covering half the gts at full precision
+        rec = np.array([0.5])
+        prec = np.array([1.0])
+        # 07 metric: max prec at t<=0.5 is 1 (6 points), 0 above → 6/11
+        assert voc_ap(rec, prec, True) == pytest.approx(6 / 11)
+        # integral metric: area = 0.5
+        assert voc_ap(rec, prec, False) == pytest.approx(0.5)
+
+
+class TestVocEval:
+    def annots(self):
+        return {
+            "img0": {
+                "boxes": np.array([[0, 0, 10, 10], [50, 50, 80, 80]], float),
+                "gt_classes": np.array([1, 1]),
+                "difficult": np.array([False, False]),
+            },
+            "img1": {
+                "boxes": np.array([[20, 20, 40, 40]], float),
+                "gt_classes": np.array([1]),
+                "difficult": np.array([False]),
+            },
+        }
+
+    def test_perfect_detection(self):
+        dets = {
+            "img0": np.array(
+                [[0, 0, 10, 10, 0.9], [50, 50, 80, 80, 0.8]], float
+            ),
+            "img1": np.array([[20, 20, 40, 40, 0.95]], float),
+        }
+        rec, prec, ap = voc_eval(dets, self.annots(), 1)
+        assert ap == pytest.approx(1.0)
+        assert rec[-1] == pytest.approx(1.0)
+
+    def test_duplicate_detection_is_fp(self):
+        dets = {
+            "img0": np.array(
+                [[0, 0, 10, 10, 0.9], [1, 1, 10, 10, 0.85]], float
+            ),
+            "img1": np.zeros((0, 5)),
+        }
+        rec, prec, ap = voc_eval(dets, self.annots(), 1)
+        # second det matches an already-matched gt → FP
+        assert prec[-1] == pytest.approx(0.5)
+
+    def test_difficult_not_counted(self):
+        ann = self.annots()
+        ann["img0"]["difficult"] = np.array([True, False])
+        dets = {
+            "img0": np.array([[0, 0, 10, 10, 0.9]], float),  # matches difficult
+            "img1": np.zeros((0, 5)),
+        }
+        rec, prec, ap = voc_eval(dets, ann, 1)
+        # det on difficult gt → ignored entirely; npos excludes difficult
+        assert len(rec) == 1 and rec[0] == 0.0
+
+    def test_low_iou_is_fp(self):
+        dets = {
+            "img0": np.array([[100, 100, 120, 120, 0.9]], float),
+            "img1": np.zeros((0, 5)),
+        }
+        rec, prec, ap = voc_eval(dets, self.annots(), 1)
+        assert ap == 0.0
+
+
+def coco_dataset():
+    images = [{"id": 1, "width": 200, "height": 200},
+              {"id": 2, "width": 200, "height": 200}]
+    cats = [{"id": 7, "name": "cat"}, {"id": 9, "name": "dog"}]
+    anns = [
+        {"id": 1, "image_id": 1, "category_id": 7, "bbox": [10, 10, 50, 50],
+         "area": 2500, "iscrowd": 0},
+        {"id": 2, "image_id": 1, "category_id": 9, "bbox": [100, 100, 40, 40],
+         "area": 1600, "iscrowd": 0},
+        {"id": 3, "image_id": 2, "category_id": 7, "bbox": [20, 20, 60, 60],
+         "area": 3600, "iscrowd": 0},
+    ]
+    return {"images": images, "annotations": anns, "categories": cats}
+
+
+class TestCocoEval:
+    def test_perfect_detections(self):
+        ds = coco_dataset()
+        results = [
+            {"image_id": a["image_id"], "category_id": a["category_id"],
+             "bbox": list(a["bbox"]), "score": 0.9}
+            for a in ds["annotations"]
+        ]
+        stats = COCOEvalBbox(ds, results).evaluate(verbose=False)
+        assert stats["AP"] == pytest.approx(1.0)
+        assert stats["AP50"] == pytest.approx(1.0)
+        assert stats["AR_100"] == pytest.approx(1.0)
+
+    def test_no_detections(self):
+        stats = COCOEvalBbox(coco_dataset(), []).evaluate(verbose=False)
+        assert stats["AP"] == pytest.approx(0.0)
+
+    def test_halfway_iou_counts_at_50_not_95(self):
+        ds = coco_dataset()
+        # shift the box so IoU ≈ 0.68: TP at 0.5/0.65, FP at 0.7+
+        results = [
+            {"image_id": 1, "category_id": 7, "bbox": [20, 10, 50, 50], "score": 0.9},
+        ]
+        stats = COCOEvalBbox(ds, results).evaluate(verbose=False)
+        assert stats["AP50"] > 0
+        assert stats["AP75"] == pytest.approx(0.0)
+        assert 0 < stats["AP"] < stats["AP50"]
+
+    def test_crowd_gt_is_ignore(self):
+        ds = coco_dataset()
+        ds["annotations"].append(
+            {"id": 4, "image_id": 2, "category_id": 9,
+             "bbox": [0, 0, 150, 150], "area": 22500, "iscrowd": 1}
+        )
+        # det inside the crowd region, class dog, scored ABOVE the real
+        # det: if crowd-ignore works it's neither TP nor FP; if it were
+        # counted FP at rank 1 the precision envelope would halve dog AP
+        results = [
+            {"image_id": 2, "category_id": 9, "bbox": [10, 10, 30, 30], "score": 0.9},
+            {"image_id": 1, "category_id": 9, "bbox": [100, 100, 40, 40], "score": 0.8},
+            # perfect cat detections so the category mean isolates dog
+            {"image_id": 1, "category_id": 7, "bbox": [10, 10, 50, 50], "score": 0.9},
+            {"image_id": 2, "category_id": 7, "bbox": [20, 20, 60, 60], "score": 0.9},
+        ]
+        stats = COCOEvalBbox(ds, results).evaluate(verbose=False)
+        assert stats["AP"] == pytest.approx(1.0, abs=1e-6)
+
+    def test_small_area_bucket(self):
+        ds = coco_dataset()
+        ds["annotations"].append(
+            {"id": 5, "image_id": 2, "category_id": 9, "bbox": [5, 5, 10, 10],
+             "area": 100, "iscrowd": 0}
+        )
+        results = [
+            {"image_id": 2, "category_id": 9, "bbox": [5, 5, 10, 10], "score": 0.9}
+        ]
+        stats = COCOEvalBbox(ds, results).evaluate(verbose=False)
+        assert stats["AP_small"] == pytest.approx(1.0)

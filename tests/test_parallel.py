@@ -1,0 +1,93 @@
+"""Data-parallel correctness on the 8-virtual-device CPU mesh — the
+multi-chip path the reference could only validate on real multi-GPU boxes
+(SURVEY §5.1)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.train import create_train_state, make_optimizer, make_train_step
+from mx_rcnn_tpu.models import FasterRCNN
+from mx_rcnn_tpu.parallel import (
+    make_mesh,
+    make_parallel_train_step,
+    replicate,
+    shard_batch,
+)
+from tests.test_model import tiny_batch, tiny_cfg
+
+
+def test_mesh_shapes():
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("data", "model")
+
+
+def test_parallel_step_runs_and_replicates():
+    cfg = tiny_cfg()
+    model = FasterRCNN(cfg)
+    mesh = make_mesh()
+    b = 8  # one image per device
+    batch = tiny_batch(np.random.RandomState(0), b=b, h=96, w=96)
+    params = model.init(
+        {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+        batch["images"][:1],
+        batch["im_info"][:1],
+        batch["gt_boxes"][:1],
+        batch["gt_valid"][:1],
+        train=True,
+    )["params"]
+    tx = make_optimizer(cfg, lambda s: 0.001)
+    state = replicate(create_train_state(params, tx), mesh)
+    sharded = shard_batch(batch, mesh)
+    step = make_parallel_train_step(model, tx, mesh)
+    new_state, aux = step(state, sharded, jax.random.key(5))
+    assert np.isfinite(float(aux["loss"]))
+    assert int(new_state.step) == 1
+    # updated params must be identical on every device (replicated)
+    leaf = jax.tree_util.tree_leaves(new_state.params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+
+def test_dp_grads_match_single_device():
+    """pmean-of-shard-grads == grad of the whole batch on one device
+    (linearity of the loss mean) — the KVStore-equivalence property."""
+    cfg = tiny_cfg()
+    model = FasterRCNN(cfg)
+    mesh = make_mesh()
+    batch = tiny_batch(np.random.RandomState(2), b=8, h=96, w=96)
+    params = model.init(
+        {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+        batch["images"][:1],
+        batch["im_info"][:1],
+        batch["gt_boxes"][:1],
+        batch["gt_valid"][:1],
+        train=True,
+    )["params"]
+    tx = make_optimizer(cfg, lambda s: 0.01)
+
+    # single-device step first: the parallel step donates its input state,
+    # which would invalidate the shared param buffers
+    s_state = create_train_state(params, tx)
+    s_step = make_train_step(model, tx, donate=False)
+    s_new, s_aux = s_step(s_state, batch, jax.random.key(9))
+
+    # the parallel path decorrelates rngs per chip, so exact equality with
+    # a single-device run isn't expected; instead check the update moved
+    # params by a comparable magnitude and stayed finite everywhere
+    p_state = replicate(create_train_state(params, tx), mesh)
+    p_step = make_parallel_train_step(model, tx, mesh)
+    p_new, p_aux = p_step(p_state, shard_batch(batch, mesh), jax.random.key(9))
+
+    p_flat = jax.tree_util.tree_leaves(p_new.params)
+    s_flat = jax.tree_util.tree_leaves(s_new.params)
+    p_norm = float(sum(jnp.sum(x.astype(jnp.float32) ** 2) for x in p_flat))
+    s_norm = float(sum(jnp.sum(x.astype(jnp.float32) ** 2) for x in s_flat))
+    assert np.isfinite(p_norm) and np.isfinite(s_norm)
+    assert abs(p_norm - s_norm) / s_norm < 0.01

@@ -1,0 +1,93 @@
+"""ROIAlign / ROIPool correctness tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.ops.roi_align import roi_align, roi_pool
+
+
+class TestRoiAlign:
+    def test_constant_map(self):
+        feat = jnp.full((20, 20, 3), 5.0)
+        rois = jnp.array([[0.0, 0.0, 160.0, 160.0]])
+        out = roi_align(feat, rois, (7, 7), 1.0 / 16.0, 2)
+        assert out.shape == (1, 7, 7, 3)
+        np.testing.assert_allclose(out, 5.0, atol=1e-5)
+
+    def test_linear_ramp_exact(self):
+        # bilinear sampling of a linear function is exact
+        h, w = 32, 32
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+        feat = jnp.array((2.0 * xx + 3.0 * yy)[:, :, None])
+        roi = np.array([[32.0, 32.0, 96.0, 96.0]], np.float32)  # feat coords 2..6
+        out = np.asarray(roi_align(jnp.array(feat), jnp.array(roi), (4, 4), 1.0 / 16.0, 2))
+        # bin (0,0) center samples average to feat coords x=y=2+0.5
+        bin_sz = 4.0 / 4.0
+        for p in range(4):
+            for q in range(4):
+                cy = 2.0 + (p + 0.5) * bin_sz
+                cx = 2.0 + (q + 0.5) * bin_sz
+                np.testing.assert_allclose(out[0, p, q, 0], 2 * cx + 3 * cy, rtol=1e-5)
+
+    def test_gradient_flows(self):
+        feat = jnp.array(np.random.RandomState(0).rand(16, 16, 4).astype(np.float32))
+        rois = jnp.array([[10.0, 10.0, 100.0, 100.0], [0.0, 0.0, 50.0, 70.0]])
+
+        def loss(f):
+            return roi_align(f, rois, (7, 7), 1.0 / 16.0, 2).sum()
+
+        g = jax.grad(loss)(feat)
+        assert g.shape == feat.shape
+        assert float(jnp.abs(g).sum()) > 0
+        # gradient concentrated inside the rois' footprint
+        assert float(jnp.abs(g[14:, 14:]).sum()) < 1e-5
+
+    def test_many_rois_chunked(self):
+        feat = jnp.array(np.random.RandomState(1).rand(10, 10, 2).astype(np.float32))
+        rois = jnp.array(np.random.RandomState(2).rand(77, 4).astype(np.float32) * 80)
+        rois = rois.at[:, 2:].set(rois[:, :2] + 40)
+        out = roi_align(feat, rois, (3, 3), 1.0 / 16.0, 2, chunk=16)
+        assert out.shape == (77, 3, 3, 2)
+        # chunking must not change values
+        out2 = roi_align(feat, rois, (3, 3), 1.0 / 16.0, 2, chunk=77)
+        np.testing.assert_allclose(out, out2, rtol=1e-6)
+
+
+class TestRoiPool:
+    def test_max_semantics(self):
+        # place a spike; any bin containing it must return the spike value
+        feat = np.zeros((10, 10, 1), np.float32)
+        feat[3, 4, 0] = 9.0
+        rois = jnp.array([[0.0, 0.0, 159.0, 159.0]])  # whole 10x10 feat map
+        out = np.asarray(roi_pool(jnp.array(feat), rois, (2, 2), 1.0 / 16.0))
+        assert out.max() == 9.0
+        assert out.shape == (1, 2, 2, 1)
+        # spike at feat (y=3,x=4) -> bin (0, 0) for 2x2 over 10 cells
+        assert out[0, 0, 0, 0] == 9.0
+
+    def test_quantization_matches_mxnet_rule(self):
+        # roi [17, 17, 48, 48] px -> round(x/16) = cells [1..3]; 1x1 pool
+        feat = np.arange(100, dtype=np.float32).reshape(10, 10, 1)
+        rois = jnp.array([[17.0, 17.0, 48.0, 48.0]])
+        out = np.asarray(roi_pool(jnp.array(feat), rois, (1, 1), 1.0 / 16.0))
+        # max over cells rows 1..3 cols 1..3 = feat[3, 3] = 33
+        assert out[0, 0, 0, 0] == 33.0
+
+    def test_tiny_roi_all_bins_cover_one_cell(self):
+        # 1-cell roi pooled to 7x7: MXNet floor/ceil edges make EVERY bin
+        # cover that single cell (never empty for in-bounds rois)
+        feat = np.full((10, 10, 1), -5.0, np.float32)
+        rois = jnp.array([[0.0, 0.0, 1.0, 1.0]])
+        out = np.asarray(roi_pool(jnp.array(feat), rois, (7, 7), 1.0 / 16.0))
+        assert (out == -5.0).all()
+
+    def test_out_of_bounds_bins_zero(self):
+        # roi hanging off the feature map edge -> clipped bins are empty
+        # -> 0 (MXNet emits 0 for empty bins)
+        feat = np.full((10, 10, 1), -5.0, np.float32)
+        rois = jnp.array([[0.0, 0.0, 300.0, 300.0]])  # cells 0..18, map has 10
+        out = np.asarray(roi_pool(jnp.array(feat), rois, (7, 7), 1.0 / 16.0))
+        assert (out == -5.0).sum() >= 9   # in-bounds bins see the map
+        assert (out == 0.0).sum() >= 20   # off-map bins zeroed

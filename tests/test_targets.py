@@ -1,0 +1,163 @@
+"""Tests for in-jit anchor targets and roi sampling (fixed RNG goldens —
+SURVEY §5.1's 'golden-batch tests for assign_anchor/sample_rois')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.ops.anchors import shifted_anchors
+from mx_rcnn_tpu.ops.targets import _random_keep_k, assign_anchor, sample_rois
+
+CFG = generate_config("resnet", "PascalVOC")
+
+
+def pad_gt(boxes, g=8):
+    boxes = np.asarray(boxes, np.float32).reshape(-1, 5)
+    out = np.zeros((g, 5), np.float32)
+    out[: len(boxes)] = boxes
+    valid = np.zeros((g,), bool)
+    valid[: len(boxes)] = True
+    return jnp.array(out), jnp.array(valid)
+
+
+class TestRandomKeepK:
+    def test_exact_count(self):
+        mask = jnp.array([True] * 50 + [False] * 14)
+        out = _random_keep_k(jax.random.key(0), mask, 20)
+        assert int(out.sum()) == 20
+        assert bool((out <= mask).all())
+
+    def test_fewer_candidates_than_k(self):
+        mask = jnp.array([True] * 5 + [False] * 59)
+        out = _random_keep_k(jax.random.key(0), mask, 20)
+        assert int(out.sum()) == 5
+
+    def test_uniformity(self):
+        # every candidate should be picked roughly equally often
+        mask = jnp.ones((10,), bool)
+        counts = np.zeros(10)
+        for i in range(200):
+            counts += np.asarray(_random_keep_k(jax.random.key(i), mask, 5))
+        assert counts.min() > 60 and counts.max() < 140  # E=100
+
+
+class TestAssignAnchor:
+    def setup_method(self):
+        self.anchors = jnp.array(shifted_anchors(25, 25, 16))  # 400x400 img
+        self.im_info = jnp.array([400.0, 400.0, 1.0])
+
+    def test_obvious_positive(self):
+        # one gt exactly matching an anchor -> that anchor labelled fg
+        gt, gv = pad_gt([[100, 100, 227, 227, 1]])  # 128x128 box
+        tg = assign_anchor(
+            self.anchors, gt[:, :4], gv, self.im_info, jax.random.key(0), CFG
+        )
+        labels = np.asarray(tg.labels)
+        assert (labels == 1).sum() >= 1
+        # fg anchors all have decent IoU with the gt
+        from mx_rcnn_tpu.ops.boxes import bbox_overlaps
+
+        ov = np.asarray(bbox_overlaps(self.anchors, gt[:1, :4]))[:, 0]
+        assert ov[labels == 1].min() > 0.3
+
+    def test_batch_size_budget(self):
+        gt, gv = pad_gt([[50, 50, 180, 180, 1], [200, 200, 350, 320, 2]])
+        tg = assign_anchor(
+            self.anchors, gt[:, :4], gv, self.im_info, jax.random.key(1), CFG
+        )
+        labels = np.asarray(tg.labels)
+        n_fg = (labels == 1).sum()
+        n_bg = (labels == 0).sum()
+        assert n_fg <= CFG.TRAIN.RPN_BATCH_SIZE * CFG.TRAIN.RPN_FG_FRACTION
+        assert n_fg + n_bg == CFG.TRAIN.RPN_BATCH_SIZE
+
+    def test_outside_anchors_ignored(self):
+        gt, gv = pad_gt([[10, 10, 390, 390, 1]])
+        small_info = jnp.array([100.0, 100.0, 1.0])  # image is only 100x100
+        tg = assign_anchor(
+            self.anchors, gt[:, :4], gv, small_info, jax.random.key(0), CFG
+        )
+        outside = ~(
+            (np.asarray(self.anchors)[:, 2] < 100)
+            & (np.asarray(self.anchors)[:, 3] < 100)
+            & (np.asarray(self.anchors)[:, 0] >= 0)
+            & (np.asarray(self.anchors)[:, 1] >= 0)
+        )
+        assert (np.asarray(tg.labels)[outside] == -1).all()
+
+    def test_weights_only_on_fg(self):
+        gt, gv = pad_gt([[100, 100, 227, 227, 1]])
+        tg = assign_anchor(
+            self.anchors, gt[:, :4], gv, self.im_info, jax.random.key(0), CFG
+        )
+        labels = np.asarray(tg.labels)
+        w = np.asarray(tg.bbox_weights)
+        assert (w[labels == 1] == 1.0).all()
+        assert (w[labels != 1] == 0.0).all()
+
+    def test_jit_and_determinism(self):
+        gt, gv = pad_gt([[100, 100, 227, 227, 1]])
+        f = jax.jit(
+            lambda k: assign_anchor(self.anchors, gt[:, :4], gv, self.im_info, k, CFG)
+        )
+        a = f(jax.random.key(7))
+        b = f(jax.random.key(7))
+        assert (np.asarray(a.labels) == np.asarray(b.labels)).all()
+
+
+class TestSampleRois:
+    def make_rois(self, rng, n=300, lo=0, hi=380):
+        r = rng.rand(n, 4).astype(np.float32) * (hi - lo) + lo
+        r[:, 2:] = np.minimum(r[:, :2] + rng.rand(n, 2) * 100 + 10, 399)
+        return jnp.array(r), jnp.ones((n,), bool)
+
+    def test_shapes_and_budget(self, rng):
+        rois, rv = self.make_rois(rng)
+        gt, gv = pad_gt([[50, 50, 150, 150, 3], [200, 200, 300, 300, 7]])
+        s = sample_rois(rois, rv, gt, gv, jax.random.key(0), CFG)
+        R, K = CFG.TRAIN.BATCH_ROIS, CFG.dataset.NUM_CLASSES
+        assert s.rois.shape == (R, 4)
+        assert s.bbox_targets.shape == (R, 4 * K)
+        labels = np.asarray(s.labels)
+        n_fg = (labels > 0).sum()
+        assert n_fg <= round(CFG.TRAIN.FG_FRACTION * R)
+        # gt boxes are appended as candidates -> at least the gts are fg
+        assert n_fg >= 2
+
+    def test_fg_labels_match_gt_class(self, rng):
+        rois, rv = self.make_rois(rng, n=50)
+        gt, gv = pad_gt([[50, 50, 150, 150, 3]])
+        s = sample_rois(rois, rv, gt, gv, jax.random.key(1), CFG)
+        labels = np.asarray(s.labels)
+        assert set(labels[labels > 0].tolist()) <= {3}
+
+    def test_bbox_target_layout(self, rng):
+        # fg targets live exactly in their class's 4-slot block
+        rois, rv = self.make_rois(rng, n=50)
+        gt, gv = pad_gt([[50, 50, 150, 150, 3]])
+        s = sample_rois(rois, rv, gt, gv, jax.random.key(2), CFG)
+        labels = np.asarray(s.labels)
+        w = np.asarray(s.bbox_weights).reshape(len(labels), -1, 4)
+        for i, lab in enumerate(labels):
+            if lab > 0:
+                assert (w[i, lab] == 1).all()
+                assert w[i].sum() == 4
+            else:
+                assert w[i].sum() == 0
+
+    def test_gt_roi_regresses_to_zero_after_norm_inverse(self, rng):
+        # a roi that IS the gt box must have ~zero raw target
+        gt, gv = pad_gt([[50, 50, 150, 150, 3]])
+        rois = jnp.tile(gt[:1, :4], (30, 1))
+        rv = jnp.ones((30,), bool)
+        s = sample_rois(rois, rv, gt, gv, jax.random.key(3), CFG)
+        labels = np.asarray(s.labels)
+        tgt = np.asarray(s.bbox_targets).reshape(len(labels), -1, 4)
+        means = np.array(CFG.TRAIN.BBOX_MEANS)
+        stds = np.array(CFG.TRAIN.BBOX_STDS)
+        for i, lab in enumerate(labels):
+            if lab > 0:
+                raw = tgt[i, lab] * stds + means
+                np.testing.assert_allclose(raw, 0, atol=1e-5)
