@@ -21,6 +21,8 @@ Three implementations, one contract:
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -28,6 +30,19 @@ import numpy as np
 from mx_rcnn_tpu.ops.boxes import bbox_overlaps
 
 _NEG_INF = -1e10
+
+
+def _use_pallas() -> bool:
+    """Pallas kernel on TPU-class backends, fori-loop fallback elsewhere.
+    Override with MX_RCNN_TPU_PALLAS=0/1."""
+    env = os.environ.get("MX_RCNN_TPU_PALLAS")
+    if env is not None:
+        return env == "1"
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return False
+    return platform in ("tpu", "axon")
 
 
 def _iou_row(box: jnp.ndarray, boxes: jnp.ndarray) -> jnp.ndarray:
@@ -51,6 +66,10 @@ def nms_mask(
     n = boxes.shape[0]
     if valid is None:
         valid = jnp.ones((n,), dtype=bool)
+    if _use_pallas():
+        from mx_rcnn_tpu.ops.pallas.nms import nms_mask_pallas
+
+        return nms_mask_pallas(boxes, scores, thresh, valid)
     scores = jnp.where(valid, scores, _NEG_INF)
     order = jnp.argsort(-scores)
     b = boxes[order].astype(jnp.float32)
