@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
-from mx_rcnn_tpu.models.heads import MaskHead, RCNNHead
+from mx_rcnn_tpu.models.heads import RCNNHead
 from mx_rcnn_tpu.models.resnet import ResNetBackbone, ResNetTopHead
 from mx_rcnn_tpu.models.rpn import RPNHead
 from mx_rcnn_tpu.models.vgg import VGGBackbone, VGGTopHead
@@ -38,7 +38,7 @@ from mx_rcnn_tpu.ops.losses import (
     weighted_smooth_l1,
 )
 from mx_rcnn_tpu.ops.proposal import propose
-from mx_rcnn_tpu.ops.roi_align import extract_roi_features
+from mx_rcnn_tpu.ops.roi_align import extract_roi_features_batched
 from mx_rcnn_tpu.ops.targets import assign_anchor, sample_rois
 
 
@@ -53,6 +53,13 @@ class FasterRCNN(nn.Module):
 
     def setup(self):
         cfg = self.cfg
+        if cfg.network.USE_FPN:
+            # loud failure until the FPN graph exists — silently training
+            # a C4 model with FPN anchor settings was ADVICE r1's top bug
+            raise NotImplementedError(
+                "USE_FPN: FasterRCNN builds a single-level C4 graph; use the "
+                "FPN model once implemented"
+            )
         dtype = _dtype_of(cfg)
         if cfg.network.name == "vgg":
             self.backbone = VGGBackbone(dtype=dtype)
@@ -67,7 +74,10 @@ class FasterRCNN(nn.Module):
         )
         self.rcnn = RCNNHead(num_classes=cfg.dataset.NUM_CLASSES, dtype=dtype)
         if cfg.network.USE_MASK:
-            self.mask_head = MaskHead(num_classes=cfg.dataset.NUM_CLASSES, dtype=dtype)
+            raise NotImplementedError(
+                "USE_MASK: mask targets/loss are not wired into the C4 "
+                "graph; the mask path lands with the FPN model"
+            )
 
     def _anchors(self, feat_h: int, feat_w: int) -> jnp.ndarray:
         net = self.cfg.network
@@ -84,16 +94,14 @@ class FasterRCNN(nn.Module):
     def _roi_features(self, feat: jnp.ndarray, rois: jnp.ndarray) -> jnp.ndarray:
         """(B, Hf, Wf, C) × (B, R, 4) → (B*R, D) head trunk features."""
         net = self.cfg.network
-        pooled = jax.vmap(
-            lambda f, r: extract_roi_features(
-                f,
-                r,
-                net.ROI_MODE,
-                net.POOLED_SIZE,
-                1.0 / net.RCNN_FEAT_STRIDE,
-                net.ROI_SAMPLE_RATIO,
-            )
-        )(feat, rois)
+        pooled = extract_roi_features_batched(
+            feat,
+            rois,
+            net.ROI_MODE,
+            net.POOLED_SIZE,
+            1.0 / net.RCNN_FEAT_STRIDE,
+            net.ROI_SAMPLE_RATIO,
+        )
         b, r = pooled.shape[0], pooled.shape[1]
         return self.top_head(pooled.reshape((b * r,) + pooled.shape[2:]))
 

@@ -21,28 +21,14 @@ Three implementations, one contract:
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from mx_rcnn_tpu.ops.boxes import bbox_overlaps
+from mx_rcnn_tpu.utils.platform import use_pallas as _use_pallas
 
 _NEG_INF = -1e10
-
-
-def _use_pallas() -> bool:
-    """Pallas kernel on TPU-class backends, fori-loop fallback elsewhere.
-    Override with MX_RCNN_TPU_PALLAS=0/1."""
-    env = os.environ.get("MX_RCNN_TPU_PALLAS")
-    if env is not None:
-        return env == "1"
-    try:
-        platform = jax.devices()[0].platform
-    except Exception:
-        return False
-    return platform in ("tpu", "axon")
 
 
 def _iou_row(box: jnp.ndarray, boxes: jnp.ndarray) -> jnp.ndarray:

@@ -156,3 +156,30 @@ def extract_roi_features(
     if mode == "roi_pool":
         return roi_pool(feat, rois, pooled, spatial_scale)
     raise ValueError(f"unknown ROI_MODE {mode!r}")
+
+
+def extract_roi_features_batched(
+    feat: jnp.ndarray,
+    rois: jnp.ndarray,
+    mode: str,
+    pooled: tuple,
+    spatial_scale: float,
+    sample_ratio: int = 2,
+) -> jnp.ndarray:
+    """(B, H, W, C) × (B, R, 4) → (B, R, ph, pw, C).
+
+    On TPU backends the roi_align path uses the Pallas MXU kernel
+    (``ops/pallas/roi_align.py``); elsewhere (and for roi_pool) the
+    chunked-gather jnp implementations under vmap.
+    """
+    from mx_rcnn_tpu.utils.platform import use_pallas
+
+    if mode == "roi_align" and use_pallas():
+        from mx_rcnn_tpu.ops.pallas.roi_align import roi_align_pallas
+
+        return roi_align_pallas(feat, rois, pooled, spatial_scale, sample_ratio)
+    return jax.vmap(
+        lambda f, r: extract_roi_features(
+            f, r, mode, pooled, spatial_scale, sample_ratio
+        )
+    )(feat, rois)

@@ -1,0 +1,159 @@
+"""Decompose the flagship train step cost component by component.
+
+Times each stage of the Faster R-CNN step (backbone, RPN, proposal/NMS,
+targets, ROI feature extraction, top head, full fwd, full train step) as
+its own jitted function on the current default backend.  This is the
+SURVEY §5.2 profiling upgrade: the reference had only a Speedometer.
+
+Usage: python -m mx_rcnn_tpu.tools.profile_step [--dtype bfloat16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters=10, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    # value fetch forces the chain on relay backends where
+    # block_until_ready can return early
+    _ = np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    _ = np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dtype", default="bfloat16", choices=["float32", "bfloat16"])
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    from __graft_entry__ import _batch, _flagship_cfg
+    from mx_rcnn_tpu.core.train import create_train_state, make_optimizer, make_train_step
+    from mx_rcnn_tpu.models import FasterRCNN
+    from mx_rcnn_tpu.models.resnet import ResNetBackbone, ResNetTopHead
+    from mx_rcnn_tpu.models.rpn import RPNHead
+    from mx_rcnn_tpu.ops.anchors import shifted_anchors
+    from mx_rcnn_tpu.ops.proposal import propose
+    from mx_rcnn_tpu.ops.roi_align import extract_roi_features_batched
+    from mx_rcnn_tpu.ops.targets import assign_anchor, sample_rois
+    from mx_rcnn_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
+
+    cfg = _flagship_cfg()
+    cfg = cfg.replace(network=dataclasses.replace(cfg.network, COMPUTE_DTYPE=args.dtype))
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    h, w = cfg.SHAPE_BUCKETS[0]
+    b = cfg.TRAIN.BATCH_IMAGES
+    batch = _batch(cfg, b, h, w)
+    fh, fw = h // 16, w // 16
+    report = {}
+
+    # --- backbone fwd + fwd/bwd
+    bb = ResNetBackbone(depth=cfg.network.depth, dtype=dtype)
+    bb_params = bb.init(jax.random.key(0), batch["images"])
+    f = jax.jit(lambda p, x: bb.apply(p, x))
+    report["backbone_fwd"] = timeit(f, bb_params, batch["images"], iters=args.iters)
+    g = jax.jit(jax.grad(lambda p, x: bb.apply(p, x).astype(jnp.float32).sum()))
+    report["backbone_fwdbwd"] = timeit(g, bb_params, batch["images"], iters=args.iters)
+    feat = jax.jit(lambda p, x: bb.apply(p, x))(bb_params, batch["images"])
+
+    # --- rpn head
+    rpn = RPNHead(num_anchors=cfg.network.NUM_ANCHORS, channels=512, dtype=dtype)
+    rpn_params = rpn.init(jax.random.key(0), feat)
+    f = jax.jit(lambda p, x: rpn.apply(p, x))
+    report["rpn_fwd"] = timeit(f, rpn_params, feat, iters=args.iters)
+
+    # --- proposal (train-size NMS: 12000 -> 2000)
+    anchors = jnp.asarray(
+        shifted_anchors(fh, fw, 16, ratios=cfg.network.ANCHOR_RATIOS,
+                        scales=cfg.network.ANCHOR_SCALES)
+    )
+    n = anchors.shape[0]
+    key = jax.random.key(0)
+    scores = jax.random.uniform(key, (n,))
+    deltas = jax.random.normal(key, (n, 4)) * 0.1
+    info = batch["im_info"][0]
+    t = cfg.TRAIN
+    f = jax.jit(
+        lambda s, d: propose(s, d, anchors, info, t.RPN_PRE_NMS_TOP_N,
+                             t.RPN_POST_NMS_TOP_N, t.RPN_NMS_THRESH, t.RPN_MIN_SIZE)
+    )
+    report["propose_train_nms"] = timeit(f, scores, deltas, iters=args.iters)
+
+    # --- assign_anchor + sample_rois
+    f = jax.jit(
+        lambda k: assign_anchor(anchors, batch["gt_boxes"][0][:, :4],
+                                batch["gt_valid"][0], info, k, cfg)
+    )
+    report["assign_anchor"] = timeit(f, key, iters=args.iters)
+    props = jax.jit(
+        lambda s, d: propose(s, d, anchors, info, t.RPN_PRE_NMS_TOP_N,
+                             t.RPN_POST_NMS_TOP_N, t.RPN_NMS_THRESH, t.RPN_MIN_SIZE)
+    )(scores, deltas)
+    f = jax.jit(
+        lambda r, v, k: sample_rois(r, v, batch["gt_boxes"][0],
+                                    batch["gt_valid"][0], k, cfg)
+    )
+    report["sample_rois"] = timeit(f, props.rois, props.valid, key, iters=args.iters)
+
+    # --- roi feature extraction (128 rois) + top head
+    rois = jax.random.uniform(key, (b, cfg.TRAIN.BATCH_ROIS, 4)) * 500
+    rois = jnp.concatenate([rois[..., :2], rois[..., :2] + 100], axis=-1)
+    net = cfg.network
+    f = jax.jit(
+        lambda ft, r: extract_roi_features_batched(
+            ft, r, net.ROI_MODE, net.POOLED_SIZE, 1.0 / net.RCNN_FEAT_STRIDE,
+            net.ROI_SAMPLE_RATIO)
+    )
+    report["roi_extract_fwd"] = timeit(f, feat, rois, iters=args.iters)
+    g = jax.jit(
+        jax.grad(lambda ft, r: extract_roi_features_batched(
+            ft, r, net.ROI_MODE, net.POOLED_SIZE, 1.0 / net.RCNN_FEAT_STRIDE,
+            net.ROI_SAMPLE_RATIO).astype(jnp.float32).sum())
+    )
+    report["roi_extract_fwdbwd"] = timeit(g, feat, rois, iters=args.iters)
+
+    pooled = f(feat, rois)[0]
+    th = ResNetTopHead(depth=cfg.network.depth, dtype=dtype)
+    th_params = th.init(jax.random.key(0), pooled)
+    f2 = jax.jit(lambda p, x: th.apply(p, x))
+    report["top_head_fwd"] = timeit(f2, th_params, pooled, iters=args.iters)
+    g2 = jax.jit(jax.grad(lambda p, x: th.apply(p, x).astype(jnp.float32).sum()))
+    report["top_head_fwdbwd"] = timeit(g2, th_params, pooled, iters=args.iters)
+
+    # --- full model
+    model = FasterRCNN(cfg)
+    params = model.init(
+        {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+        batch["images"], batch["im_info"], batch["gt_boxes"], batch["gt_valid"],
+        train=True,
+    )["params"]
+    tx = make_optimizer(cfg, lambda s: cfg.TRAIN.LEARNING_RATE)
+    state = create_train_state(params, tx)
+    step = make_train_step(model, tx, donate=False)
+    report["full_train_step"] = timeit(
+        lambda: step(state, batch, jax.random.key(0)), iters=args.iters
+    )
+
+    print(f"\n=== profile ({args.dtype}, {jax.devices()[0].platform}) ===")
+    for k, v in sorted(report.items(), key=lambda kv: -kv[1]):
+        print(f"{k:24s} {v * 1e3:9.2f} ms")
+    print(f"{'imgs/sec (full step)':24s} {b / report['full_train_step']:9.2f}")
+
+
+if __name__ == "__main__":
+    main()

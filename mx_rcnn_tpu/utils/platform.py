@@ -23,6 +23,21 @@ def enable_compile_cache(path: str = "/tmp/jax_cache") -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def use_pallas() -> bool:
+    """Pallas kernels on TPU-class backends, jnp fallbacks elsewhere.
+    Override with MX_RCNN_TPU_PALLAS=0/1."""
+    env = os.environ.get("MX_RCNN_TPU_PALLAS")
+    if env is not None:
+        return env == "1"
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        return False
+    return platform in ("tpu", "axon")
+
+
 def force_cpu(n_devices: int = 1) -> None:
     """Switch JAX to the host CPU backend with ``n_devices`` virtual
     devices.  Must run before the first backend initialization in this

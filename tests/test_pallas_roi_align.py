@@ -1,0 +1,84 @@
+"""Pallas ROIAlign kernel vs the jnp gather reference, fwd and bwd
+(SURVEY §5.1/§7.3: the ROIAlign backward is "the fiddliest kernel; test
+against a jax.grad of a gather-based reference")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.ops.pallas.roi_align import roi_align_pallas
+from mx_rcnn_tpu.ops.roi_align import roi_align
+
+
+def random_rois(rng, r, h_img, w_img):
+    """(R, 4) boxes in image coords, including degenerate/border cases."""
+    x1 = rng.rand(r) * w_img * 0.8
+    y1 = rng.rand(r) * h_img * 0.8
+    x2 = x1 + rng.rand(r) * (w_img - x1)
+    y2 = y1 + rng.rand(r) * (h_img - y1)
+    rois = np.stack([x1, y1, x2, y2], axis=1).astype(np.float32)
+    if r >= 4:
+        rois[0] = [0, 0, w_img - 1, h_img - 1]          # full image
+        rois[1] = [5, 5, 5.5, 5.5]                       # sub-cell roi
+        rois[2] = [w_img - 2, h_img - 2, w_img + 50, h_img + 50]  # past border
+        rois[3] = [0, 0, 0, 0]                           # degenerate at origin
+    return rois
+
+
+class TestPallasRoiAlign:
+    @pytest.mark.parametrize("pooled", [(7, 7), (14, 14)])
+    def test_fwd_matches_jnp(self, rng, pooled):
+        h, w, c = 20, 30, 128
+        feat = jnp.asarray(rng.randn(h, w, c).astype(np.float32))
+        rois = jnp.asarray(random_rois(rng, 8, h * 16, w * 16))
+        ref = roi_align(feat, rois, pooled, 1.0 / 16, 2)
+        got = roi_align_pallas(
+            feat[None], rois[None], pooled, 1.0 / 16, 2, True
+        )[0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_fwd_batched(self, rng):
+        b, h, w, c = 3, 12, 16, 256
+        feat = jnp.asarray(rng.randn(b, h, w, c).astype(np.float32))
+        rois = jnp.asarray(
+            np.stack([random_rois(rng, 6, h * 16, w * 16) for _ in range(b)])
+        )
+        got = roi_align_pallas(feat, rois, (7, 7), 1.0 / 16, 2, True)
+        for i in range(b):
+            ref = roi_align(feat[i], rois[i], (7, 7), 1.0 / 16, 2)
+            np.testing.assert_allclose(
+                np.asarray(got[i]), np.asarray(ref), rtol=1e-5, atol=1e-5
+            )
+
+    def test_bwd_matches_jnp_grad(self, rng):
+        h, w, c = 14, 18, 128
+        feat = jnp.asarray(rng.randn(h, w, c).astype(np.float32))
+        rois = jnp.asarray(random_rois(rng, 5, h * 16, w * 16))
+        cot = jnp.asarray(rng.randn(5, 7, 7, c).astype(np.float32))
+
+        ref_grad = jax.grad(
+            lambda f: (roi_align(f, rois, (7, 7), 1.0 / 16, 2) * cot).sum()
+        )(feat)
+        got_grad = jax.grad(
+            lambda f: (
+                roi_align_pallas(f[None], rois[None], (7, 7), 1.0 / 16, 2, True)[0]
+                * cot
+            ).sum()
+        )(feat)
+        np.testing.assert_allclose(
+            np.asarray(got_grad), np.asarray(ref_grad), rtol=1e-4, atol=1e-4
+        )
+
+    def test_bf16_finite_and_close(self, rng):
+        h, w, c = 10, 12, 128
+        feat = jnp.asarray(rng.randn(h, w, c).astype(np.float32))
+        rois = jnp.asarray(random_rois(rng, 4, h * 16, w * 16))
+        ref = roi_align(feat, rois, (7, 7), 1.0 / 16, 2)
+        got = roi_align_pallas(
+            feat[None].astype(jnp.bfloat16), rois[None], (7, 7), 1.0 / 16, 2, True
+        )[0]
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref), rtol=0.05, atol=0.05
+        )
