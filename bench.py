@@ -33,10 +33,13 @@ def main():
     from mx_rcnn_tpu.models import FasterRCNN
 
     cfg = _flagship_cfg()
-    # bf16 compute (f32 params) rides the MXU — the perf configuration;
-    # entry()/dryrun keep f32 for conservative compile/correctness checks
+    # The perf configuration: bf16 compute (f32 params) rides the MXU, and
+    # 8 images/chip/step amortize fixed per-step costs (measured: b1=29.9,
+    # b2=40.2, b4=44.6, b8=52.9 img/s).  entry()/dryrun keep f32 batch-1
+    # for conservative compile/correctness checks.
     cfg = cfg.replace(
-        network=dataclasses.replace(cfg.network, COMPUTE_DTYPE="bfloat16")
+        network=dataclasses.replace(cfg.network, COMPUTE_DTYPE="bfloat16"),
+        TRAIN=dataclasses.replace(cfg.TRAIN, BATCH_IMAGES=8),
     )
     model = FasterRCNN(cfg)
     h, w = cfg.SHAPE_BUCKETS[0]

@@ -44,6 +44,9 @@ class TrainConfig:
     RPN_NEGATIVE_OVERLAP: float = 0.3
     RPN_CLOBBER_POSITIVES: bool = False
     RPN_BBOX_WEIGHTS: Tuple[float, float, float, float] = (1.0, 1.0, 1.0, 1.0)
+    # UNIMPLEMENTED placeholder: only the reference default (-1 = uniform
+    # example weighting) is supported; non-default values raise in
+    # generate_config rather than silently diverging
     RPN_POSITIVE_WEIGHT: float = -1.0
     # RPN proposal generation, train graph (reference: rcnn/symbol/proposal.py)
     RPN_NMS_THRESH: float = 0.7
@@ -81,8 +84,12 @@ class TestConfig:
     MAX_PER_IMAGE: int = 100
     # fixed per-image detection budget after per-class NMS (TPU fixed shape)
     DET_PER_CLASS: int = 100
-    # proposal-recall eval
+    # proposal dumping for alternate training / recall eval
+    # (reference: config.TEST.PROPOSAL_* — a larger budget than detection's
+    # 300 so the Fast-RCNN stage sees the full 2000-proposal pool)
     PROPOSAL_NMS: float = 0.7
+    PROPOSAL_PRE_NMS_TOP_N: int = 20000
+    PROPOSAL_POST_NMS_TOP_N: int = 2000
 
 
 @dataclass(frozen=True)
@@ -93,6 +100,9 @@ class NetworkConfig:
     depth: int = 101  # resnet depth: 50 / 101 (ignored for vgg)
     PIXEL_MEANS: Tuple[float, float, float] = (123.68, 116.779, 103.939)  # RGB
     PIXEL_STDS: Tuple[float, float, float] = (1.0, 1.0, 1.0)
+    # UNIMPLEMENTED placeholder: bucket padding (SHAPE_BUCKETS) subsumes
+    # the reference's pad-to-stride; non-zero values raise in
+    # generate_config
     IMAGE_STRIDE: int = 0
     RPN_FEAT_STRIDE: int = 16
     RCNN_FEAT_STRIDE: int = 16
@@ -212,4 +222,9 @@ def generate_config(network: str, dataset: str, **overrides: Any) -> Config:
     cfg = Config(network=net, dataset=ds, TRAIN=train, TEST=test)
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
+    # placeholder-field guards AFTER overrides so they can actually fire
+    if cfg.network.IMAGE_STRIDE != 0:
+        raise NotImplementedError("IMAGE_STRIDE is subsumed by SHAPE_BUCKETS")
+    if cfg.TRAIN.RPN_POSITIVE_WEIGHT != -1.0:
+        raise NotImplementedError("RPN_POSITIVE_WEIGHT != -1 is not supported")
     return cfg

@@ -1,0 +1,112 @@
+"""Shared stage-training loop for the alternate pipeline tools.
+
+Reference: the per-stage ``train_net`` bodies of
+``rcnn/tools/train_rpn.py`` / ``rcnn/tools/train_rcnn.py`` (each rebuilt
+the Module.fit plumbing); here one ``fit`` serves every stage graph since
+``make_train_step`` dispatches on batch keys.  The end2end CLI keeps its
+own richer loop (resume, DP mesh) in ``tools/train_end2end.py``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.core.metrics import MetricTracker, Speedometer
+from mx_rcnn_tpu.core.train import (
+    create_train_state,
+    make_lr_schedule,
+    make_optimizer,
+    make_train_step,
+)
+from mx_rcnn_tpu.data.loader import TrainLoader
+
+logger = logging.getLogger(__name__)
+
+
+def merge_params(init_params: Dict, donor: Dict) -> Dict:
+    """Copy matching top-level subtrees (backbone/top_head/rpn/rcnn) from
+    ``donor`` into a fresh copy of ``init_params``.
+
+    The stage models share subtree names by construction
+    (``models/stage_models.py``), so transferring e.g. an RPNOnly
+    checkpoint into a FastRCNN init is a dict update on the intersection.
+    """
+    out = dict(jax.device_get(init_params))
+    for k in out:
+        if k in donor:
+            out[k] = jax.device_get(donor[k])
+    return out
+
+
+def fit(
+    model,
+    cfg: Config,
+    roidb: List[Dict],
+    *,
+    epochs: int,
+    seed: int = 0,
+    proposal_count: int = 0,
+    fixed_params: Optional[tuple] = None,
+    init_donor: Optional[Dict] = None,
+    frequent: int = 20,
+    max_steps: int = 0,
+) -> Dict:
+    """Train ``model`` on ``roidb`` and return the final params.
+
+    ``init_donor``: param tree whose matching subtrees seed the init
+    (pretrained backbone / previous stage).  ``fixed_params``: freeze-set
+    override (FIXED_PARAMS_SHARED for stage-2).
+    """
+    loader = TrainLoader(
+        roidb, cfg, cfg.TRAIN.BATCH_IMAGES,
+        shuffle=cfg.TRAIN.SHUFFLE, seed=seed,
+        proposal_count=proposal_count,
+    )
+    steps_per_epoch = max(len(loader), 1)
+    # init batch built directly — peeking the loader's iterator would leak
+    # its prefetch thread and consume the epoch-0 shuffle plan
+    from mx_rcnn_tpu.data.loader import _orientation_bucket, make_batch
+
+    first = [roidb[0]] * cfg.TRAIN.BATCH_IMAGES  # one record: shapes only
+    batch0 = make_batch(
+        first, cfg, _orientation_bucket(first[0], cfg.SHAPE_BUCKETS),
+        proposal_count=proposal_count, seeds=list(range(len(first))),
+    )
+    params = model.init(
+        {"params": jax.random.key(seed), "sampling": jax.random.key(seed + 1)},
+        train=True,
+        **batch0,
+    )["params"]
+    if init_donor is not None:
+        params = merge_params(params, init_donor)
+
+    tx = make_optimizer(
+        cfg, make_lr_schedule(cfg, steps_per_epoch), fixed_params=fixed_params
+    )
+    state = create_train_state(params, tx)
+    step_fn = make_train_step(model, tx, donate=False)
+    rng = jax.random.key(seed + 123)
+
+    tracker = MetricTracker()
+    speedo = Speedometer(cfg.TRAIN.BATCH_IMAGES, frequent)
+    total_steps = 0
+    for epoch in range(epochs):
+        for batch in loader:
+            state, aux = step_fn(state, batch, rng)
+            tracker.update({k: float(v) for k, v in jax.device_get(aux).items()})
+            total_steps += 1
+            speedo(epoch, total_steps, tracker)
+            if max_steps and total_steps >= max_steps:
+                break
+        if max_steps and total_steps >= max_steps:
+            break
+    last_loss = float(jax.device_get(aux)["loss"]) if total_steps else float("nan")
+    logger.info("fit done: %d steps, last loss %.4f", total_steps, last_loss)
+    if total_steps and not np.isfinite(last_loss):
+        logger.warning("fit finished with non-finite loss")
+    return jax.device_get(state.params)

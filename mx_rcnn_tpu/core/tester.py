@@ -36,14 +36,15 @@ class Predictor:
     def __init__(self, model, params):
         self.model = model
         self.params = params
+        # batch keys match the model __call__ kwargs (gt keys are accepted
+        # and ignored by test forwards; FastRCNN additionally consumes
+        # proposals/prop_valid)
         self._fn = jax.jit(
-            lambda p, images, im_info: model.apply(
-                {"params": p}, images, im_info, train=False
-            )
+            lambda p, batch: model.apply({"params": p}, train=False, **batch)
         )
 
     def predict(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        out = self._fn(self.params, batch["images"], batch["im_info"])
+        out = self._fn(self.params, batch)
         return jax.device_get(out)
 
 
@@ -77,12 +78,17 @@ def pred_eval(
     imdb,
     cfg: Config,
     thresh: Optional[float] = None,
-    vis: bool = False,
+    vis: Optional[str] = None,
+    dump_path: Optional[str] = None,
+    vis_thresh: float = 0.7,
 ):
     """Full-dataset evaluation loop (pred_eval twin).
 
     Returns (all_boxes, eval_results) where
-    ``all_boxes[cls][img] = (n, 5)``.
+    ``all_boxes[cls][img] = (n, 5)``.  ``dump_path`` writes the all_boxes
+    pickle that ``tools/reeval.py`` re-scores (the reference's
+    detections.pkl); ``vis`` names a directory that receives per-image
+    detection overlays (vis_all_detection twin).
     """
     te = cfg.TEST
     thresh = te.SCORE_THRESH if thresh is None else thresh
@@ -114,10 +120,25 @@ def pred_eval(
                 for j in range(1, num_classes):
                     keep = all_boxes[j][i][:, 4] >= cut
                     all_boxes[j][i] = all_boxes[j][i][keep]
+        if vis:
+            import os
+
+            from mx_rcnn_tpu.data.loader import _load_record_image
+            from mx_rcnn_tpu.utils.visualize import draw_detections, save_image
+
+            os.makedirs(vis, exist_ok=True)
+            dets_by_class = {
+                imdb.classes[j]: all_boxes[j][i] for j in range(1, num_classes)
+            }
+            im = draw_detections(_load_record_image(rec), dets_by_class, vis_thresh)
+            save_image(os.path.join(vis, f"det_{i:06d}.png"), im)
         if (i + 1) % 100 == 0:
             logger.info(
                 "im_detect %d/%d %.3fs/im", i + 1, num_images, (time.time() - t0) / (i + 1)
             )
+    if dump_path:
+        with open(dump_path, "wb") as f:
+            pickle.dump(all_boxes, f, pickle.HIGHEST_PROTOCOL)
     results = imdb.evaluate_detections(all_boxes)
     return all_boxes, results
 

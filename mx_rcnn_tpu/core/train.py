@@ -8,11 +8,15 @@ bucket; data parallelism is the same function under ``shard_map`` with a
 ``psum`` on grads (``mx_rcnn_tpu/parallel``) — the comm backend is the
 compiler.
 
-Optimizer semantics match MXNet SGD exactly:
+Optimizer semantics follow MXNet SGD:
 - gradient clipped element-wise to ±CLIP_GRADIENT (MXNet ``clip_gradient``),
 - weight decay added to the gradient *before* momentum (MXNet SGD),
 - momentum 0.9, piecewise-constant lr (MultiFactorScheduler),
 - frozen params (FIXED_PARAMS) get zero updates via an optax mask.
+One knowing deviation: lr is applied *after* the momentum accumulator
+(optax.trace then scale), while MXNet folds lr into the momentum update —
+at an LR_FACTOR boundary the existing momentum buffer is rescaled by the
+new lr here, so the two diverge transiently for ~1/(1-momentum) steps.
 """
 
 from __future__ import annotations
@@ -50,9 +54,14 @@ def is_frozen_path(path: Tuple[str, ...], fixed_params: Sequence[str]) -> bool:
 
 
 def make_optimizer(
-    cfg: Config, lr_schedule: Callable[[jnp.ndarray], jnp.ndarray]
+    cfg: Config,
+    lr_schedule: Callable[[jnp.ndarray], jnp.ndarray],
+    fixed_params: tuple | None = None,
 ) -> optax.GradientTransformation:
+    """``fixed_params`` overrides the freeze set (stage-2 alternate
+    training freezes FIXED_PARAMS_SHARED instead of FIXED_PARAMS)."""
     t = cfg.TRAIN
+    fixed = cfg.network.FIXED_PARAMS if fixed_params is None else fixed_params
     sgd = optax.chain(
         optax.clip(t.CLIP_GRADIENT),
         optax.add_decayed_weights(t.WD),
@@ -63,7 +72,7 @@ def make_optimizer(
     def label_fn(params):
         flat = flax.traverse_util.flatten_dict(params)
         labels = {
-            k: "frozen" if is_frozen_path(k, cfg.network.FIXED_PARAMS) else "train"
+            k: "frozen" if is_frozen_path(k, fixed) else "train"
             for k in flat
         }
         return flax.traverse_util.unflatten_dict(labels)
@@ -100,14 +109,11 @@ def make_train_step(
         rng = jax.random.fold_in(rng, state.step)
 
         def loss_fn(params):
+            # batch keys match the model __call__ signature (images,
+            # im_info, gt_boxes, gt_valid [, proposals, prop_valid]) so
+            # one step builder serves FasterRCNN / RPNOnly / FastRCNN
             loss, aux = model.apply(
-                {"params": params},
-                batch["images"],
-                batch["im_info"],
-                batch["gt_boxes"],
-                batch["gt_valid"],
-                train=True,
-                rngs={"sampling": rng},
+                {"params": params}, train=True, rngs={"sampling": rng}, **batch
             )
             return loss, aux
 
@@ -115,7 +121,15 @@ def make_train_step(
         aux = dict(aux)
         aux["loss"] = loss
         if pmean_axis is not None:
-            grads = jax.lax.pmean(grads, pmean_axis)
+            # Under shard_map, params arrive replicated (device-invariant)
+            # while the loss is device-varying, so autodiff's transpose
+            # rule has ALREADY psum-med the param cotangents across the
+            # axis — an explicit pmean here would be a no-op on the sum,
+            # silently training with sum-reduced (axis_size×) gradients.
+            # Divide by the axis size to get the mean; the exact
+            # DP-vs-single-device equality test guards this invariant.
+            n = jax.lax.psum(1, pmean_axis)
+            grads = jax.tree_util.tree_map(lambda g: g / n, grads)
             aux = jax.lax.pmean(
                 {k: v.astype(jnp.float32) for k, v in aux.items()}, pmean_axis
             )

@@ -71,8 +71,14 @@ def pad_to_bucket(im: np.ndarray, bucket: Tuple[int, int]) -> np.ndarray:
     """Zero-pad bottom/right to the bucket shape (boxes stay valid)."""
     h, w = im.shape[:2]
     bh, bw = bucket
+    if h > bh or w > bw:
+        raise ValueError(
+            f"image ({h}, {w}) exceeds bucket ({bh}, {bw}) — SCALES and "
+            f"SHAPE_BUCKETS are inconsistent (silent cropping would drop "
+            f"gt boxes)"
+        )
     out = np.zeros((bh, bw) + im.shape[2:], dtype=im.dtype)
-    out[: min(h, bh), : min(w, bw)] = im[: min(h, bh), : min(w, bw)]
+    out[:h, :w] = im
     return out
 
 

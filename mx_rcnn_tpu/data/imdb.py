@@ -80,6 +80,13 @@ class IMDB:
             new_rec = dict(rec)
             new_rec["boxes"] = boxes
             new_rec["flipped"] = True
+            if "proposals" in rec and len(rec["proposals"]):
+                props = rec["proposals"].copy()
+                oldx1 = props[:, 0].copy()
+                oldx2 = props[:, 2].copy()
+                props[:, 0] = rec["width"] - oldx2 - 1
+                props[:, 2] = rec["width"] - oldx1 - 1
+                new_rec["proposals"] = props
             flipped.append(new_rec)
         return list(roidb) + flipped
 

@@ -46,11 +46,18 @@ def make_batch(
     cfg: Config,
     bucket: Tuple[int, int],
     images: Optional[Sequence[np.ndarray]] = None,
+    proposal_count: int = 0,
+    seeds: Optional[Sequence[int]] = None,
 ) -> Dict[str, np.ndarray]:
     """Assemble one padded train batch from roidb records.
 
     Boxes are scaled by the resize factor (the reference scales gt_boxes by
     im_scale in ``get_rpn_batch``); gt arrays padded to MAX_GT_BOXES.
+
+    ``proposal_count`` > 0 additionally emits ``proposals``/``prop_valid``
+    padded to that count from each record's ``proposals`` field (the
+    ROIIter role: Fast-RCNN batches from a proposal roidb,
+    ``rcnn/io/rcnn.py :: get_rcnn_batch``).
     """
     scales = cfg.dataset.SCALES[0]
     g = cfg.dataset.MAX_GT_BOXES
@@ -60,6 +67,9 @@ def make_batch(
     im_info = np.zeros((n, 3), np.float32)
     gt_boxes = np.zeros((n, g, 5), np.float32)
     gt_valid = np.zeros((n, g), bool)
+    if proposal_count:
+        proposals = np.zeros((n, proposal_count, 4), np.float32)
+        prop_valid = np.zeros((n, proposal_count), bool)
     for i, rec in enumerate(records):
         im = images[i] if images is not None else _load_record_image(rec)
         padded, info = prepare_image(
@@ -77,12 +87,25 @@ def make_batch(
         gt_boxes[i, :k, :4] = boxes[:k]
         gt_boxes[i, :k, 4] = rec["gt_classes"][:k]
         gt_valid[i, :k] = True
-    return {
+        if proposal_count:
+            p = np.asarray(rec["proposals"], np.float32) * info[2]
+            k = min(len(p), proposal_count)
+            proposals[i, :k] = p[:k]
+            prop_valid[i, :k] = True
+    out = {
         "images": out_images,
         "im_info": im_info,
         "gt_boxes": gt_boxes,
         "gt_valid": gt_valid,
     }
+    if seeds is not None:
+        # per-image sampling seeds: in-graph roi/anchor subsampling keys
+        # derive from these, making draws identical across DP topologies
+        out["sample_seeds"] = np.asarray(seeds, np.int32)
+    if proposal_count:
+        out["proposals"] = proposals
+        out["prop_valid"] = prop_valid
+    return out
 
 
 def _orientation_bucket(rec: Dict, buckets) -> Tuple[int, int]:
@@ -105,6 +128,7 @@ class TrainLoader:
         shuffle: bool = True,
         seed: int = 0,
         prefetch: int = 2,
+        proposal_count: int = 0,
     ):
         self.roidb = roidb
         self.cfg = cfg
@@ -112,6 +136,7 @@ class TrainLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.prefetch = prefetch
+        self.proposal_count = proposal_count
         self.epoch = 0
 
     def __len__(self) -> int:
@@ -141,9 +166,13 @@ class TrainLoader:
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         plan = self._epoch_plan(self.epoch)
         self.epoch += 1
+        pc = self.proposal_count
         if self.prefetch <= 0:
             for bucket, idxs in plan:
-                yield make_batch([self.roidb[i] for i in idxs], self.cfg, bucket)
+                yield make_batch(
+                    [self.roidb[i] for i in idxs], self.cfg, bucket,
+                    proposal_count=pc, seeds=idxs,
+                )
             return
 
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
@@ -152,7 +181,12 @@ class TrainLoader:
         def worker():
             try:
                 for bucket, idxs in plan:
-                    q.put(make_batch([self.roidb[i] for i in idxs], self.cfg, bucket))
+                    q.put(
+                        make_batch(
+                            [self.roidb[i] for i in idxs], self.cfg, bucket,
+                            proposal_count=pc, seeds=idxs,
+                        )
+                    )
             finally:
                 q.put(stop)
 
@@ -167,11 +201,13 @@ class TrainLoader:
 
 class TestLoader:
     """batch=1 inference iterator (TestLoader twin); also yields the roidb
-    record so eval can undo the resize scale."""
+    record so eval can undo the resize scale.  ``proposal_count`` > 0
+    emits each record's dumped proposals too (Fast-RCNN test mode)."""
 
-    def __init__(self, roidb: List[Dict], cfg: Config):
+    def __init__(self, roidb: List[Dict], cfg: Config, proposal_count: int = 0):
         self.roidb = roidb
         self.cfg = cfg
+        self.proposal_count = proposal_count
 
     def __len__(self) -> int:
         return len(self.roidb)
@@ -179,5 +215,7 @@ class TestLoader:
     def __iter__(self):
         for rec in self.roidb:
             bucket = _orientation_bucket(rec, self.cfg.SHAPE_BUCKETS)
-            batch = make_batch([rec], self.cfg, bucket)
+            batch = make_batch(
+                [rec], self.cfg, bucket, proposal_count=self.proposal_count
+            )
             yield rec, batch

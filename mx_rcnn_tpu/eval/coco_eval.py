@@ -11,8 +11,8 @@ vendored copy may not be copied (SURVEY N5).  Faithful to the protocol:
   intersection-over-det-area IoU, unmatched dets on ignored gt ignored,
 - 12 summary statistics in the standard order.
 
-Mask (segm) evaluation is out of scope here; the native RLE mask API
-lives in ``mx_rcnn_tpu/native`` for the Mask R-CNN extension.
+This module evaluates bbox detections; segm evaluation lives in
+:func:`coco_eval` via ``iou_type='segm'`` once mask support lands.
 """
 
 from __future__ import annotations
@@ -68,10 +68,30 @@ class COCOEvalBbox:
                 self._dts[key].append(det)
 
     def _evaluate_img(self, img_id, cat_id, area_rng, max_det):
+        """Match one (image, category) pair under one area range.
+
+        Greedy score-descending matching; the threshold axis (T=10) runs
+        vectorized — only the det axis is a Python loop (the greedy
+        sequential dependency).  Truncation to ``max_det`` happens here
+        for the standalone call; ``_accumulate`` instead slices cached
+        max-budget results (valid because the match of det *i* never
+        depends on later dets).
+        """
+        out = self._match_pair(img_id, cat_id, area_rng)
+        if out is None or max_det >= out["dt_matches"].shape[1]:
+            return out
+        return {
+            "dt_matches": out["dt_matches"][:, :max_det],
+            "dt_scores": out["dt_scores"][:max_det],
+            "dt_ignore": out["dt_ignore"][:, :max_det],
+            "gt_ignore": out["gt_ignore"],
+            "num_gt": out["num_gt"],
+        }
+
+    def _match_pair(self, img_id, cat_id, area_rng):
         gts = self._gts[(img_id, cat_id)]
-        dts = sorted(
-            self._dts[(img_id, cat_id)], key=lambda d: -d["score"]
-        )[:max_det]
+        dts = sorted(self._dts[(img_id, cat_id)], key=lambda d: -d["score"])
+        dts = dts[: max(MAX_DETS)]
         if not gts and not dts:
             return None
 
@@ -83,34 +103,43 @@ class COCOEvalBbox:
         g_ignore = g_crowd | (g_area < area_rng[0]) | (g_area > area_rng[1])
         # sort gts: non-ignored first (protocol requirement)
         g_order = np.argsort(g_ignore, kind="stable")
-        g_boxes, g_crowd, g_ignore = g_boxes[g_order], g_crowd[g_order], g_ignore[g_order]
+        g_boxes, g_crowd, g_ignore = (
+            g_boxes[g_order], g_crowd[g_order], g_ignore[g_order]
+        )
 
         d_boxes = np.array([d["bbox"] for d in dts]).reshape(-1, 4)
         d_scores = np.array([d["score"] for d in dts])
         ious = _iou_xywh(d_boxes, g_boxes, g_crowd)
 
         T, D, G = len(IOU_THRS), len(dts), len(gts)
+        thr = np.minimum(IOU_THRS, 1 - 1e-10)                       # (T,)
         dt_m = -np.ones((T, D), int)
-        gt_m = -np.ones((T, G), int)
         dt_ig = np.zeros((T, D), bool)
-        for ti, t in enumerate(IOU_THRS):
+        if G:
+            avail = np.ones((T, G), bool)
+            ni = ~g_ignore[None, :]                                 # (1, G)
             for di in range(D):
-                best_iou = min(t, 1 - 1e-10)
-                best_g = -1
-                for gi in range(G):
-                    if gt_m[ti, gi] >= 0 and not g_crowd[gi]:
-                        continue  # taken (crowd can absorb many dets)
-                    # stop at ignored gts once a non-ignored match exists
-                    if best_g >= 0 and not g_ignore[best_g] and g_ignore[gi]:
-                        break
-                    if ious[di, gi] < best_iou:
-                        continue
-                    best_iou = ious[di, gi]
-                    best_g = gi
-                if best_g >= 0:
-                    dt_m[ti, di] = best_g
-                    gt_m[ti, best_g] = di
-                    dt_ig[ti, di] = g_ignore[best_g]
+                r = ious[di]                                        # (G,)
+                cand = avail & (r[None, :] >= thr[:, None])         # (T, G)
+                # a non-ignored match (any iou) outranks every ignored gt;
+                # within a class, max iou wins — LAST gt on ties, matching
+                # the pycocotools loop's >= update (argmax on the reversed
+                # axis picks the last maximum)
+                r_ni = np.where(cand & ni, r[None, :], -1.0)
+                r_ig = np.where(cand & ~ni, r[None, :], -1.0)
+                has_ni = r_ni.max(axis=1) > -1.0
+                has_ig = r_ig.max(axis=1) > -1.0
+                last_ni = G - 1 - r_ni[:, ::-1].argmax(axis=1)
+                last_ig = G - 1 - r_ig[:, ::-1].argmax(axis=1)
+                best = np.where(
+                    has_ni, last_ni, np.where(has_ig, last_ig, -1)
+                )                                                   # (T,)
+                matched = best >= 0
+                dt_m[:, di] = best
+                dt_ig[matched, di] = g_ignore[best[matched]]
+                # matched non-crowd gts leave the pool (crowds absorb many)
+                take = matched & ~g_crowd[np.clip(best, 0, G - 1)]
+                avail[take, best[take]] = False
         # unmatched dets outside the area range are ignored
         d_area = d_boxes[:, 2] * d_boxes[:, 3]
         d_out = (d_area < area_rng[0]) | (d_area > area_rng[1])
@@ -123,23 +152,42 @@ class COCOEvalBbox:
             "num_gt": int((~g_ignore).sum()),
         }
 
-    def _accumulate(self, area_rng, max_det):
+    def _pair_evals(self, area_rng_key):
+        """Cached per-(img, cat) match results at the max det budget for
+        one area range — shared by every maxDet setting."""
+        if not hasattr(self, "_pair_cache"):
+            self._pair_cache = {}
+        if area_rng_key not in self._pair_cache:
+            area_rng = AREA_RNGS[area_rng_key]
+            by_cat = {c: [] for c in self.cat_ids}
+            for (img_id, cat_id), dts in self._dts.items():
+                if not dts and not self._gts[(img_id, cat_id)]:
+                    continue
+                e = self._match_pair(img_id, cat_id, area_rng)
+                if e is not None:
+                    by_cat[cat_id].append(e)
+            self._pair_cache[area_rng_key] = by_cat
+        return self._pair_cache[area_rng_key]
+
+    def _accumulate(self, area_rng_key, max_det):
         """→ precision (T, R, K), recall (T, K) over categories K."""
         T, R, K = len(IOU_THRS), len(REC_THRS), len(self.cat_ids)
         precision = -np.ones((T, R, K))
         recall = -np.ones((T, K))
+        by_cat = self._pair_evals(area_rng_key)
         for ki, cat_id in enumerate(self.cat_ids):
-            evals = [
-                self._evaluate_img(i, cat_id, area_rng, max_det)
-                for i in self.img_ids
-            ]
-            evals = [e for e in evals if e is not None]
+            evals = by_cat[cat_id]
             if not evals:
                 continue
-            scores = np.concatenate([e["dt_scores"] for e in evals])
+            # top-max_det slice per image, then merge score-descending
+            scores = np.concatenate([e["dt_scores"][:max_det] for e in evals])
             order = np.argsort(-scores, kind="mergesort")
-            dt_m = np.concatenate([e["dt_matches"] for e in evals], axis=1)[:, order]
-            dt_ig = np.concatenate([e["dt_ignore"] for e in evals], axis=1)[:, order]
+            dt_m = np.concatenate(
+                [e["dt_matches"][:, :max_det] for e in evals], axis=1
+            )[:, order]
+            dt_ig = np.concatenate(
+                [e["dt_ignore"][:, :max_det] for e in evals], axis=1
+            )[:, order]
             npig = sum(e["num_gt"] for e in evals)
             if npig == 0:
                 continue
@@ -147,22 +195,23 @@ class COCOEvalBbox:
             fps = (dt_m == -1) & ~dt_ig
             tp_sum = np.cumsum(tps, axis=1).astype(float)
             fp_sum = np.cumsum(fps, axis=1).astype(float)
+            nd = tp_sum.shape[1]
+            if nd == 0:
+                recall[:, ki] = 0.0
+                precision[:, :, ki] = 0.0
+                continue
+            rc = tp_sum / npig                                       # (T, nd)
+            pr = tp_sum / np.maximum(
+                tp_sum + fp_sum, np.finfo(np.float64).eps
+            )
+            recall[:, ki] = rc[:, -1]
+            # precision envelope (monotone decreasing), vectorized over T
+            env = np.maximum.accumulate(pr[:, ::-1], axis=1)[:, ::-1]
             for ti in range(T):
-                tp, fp = tp_sum[ti], fp_sum[ti]
-                nd = len(tp)
-                rc = tp / npig
-                pr = tp / np.maximum(tp + fp, np.finfo(np.float64).eps)
-                recall[ti, ki] = rc[-1] if nd else 0.0
-                # precision envelope (monotone decreasing)
+                inds = np.searchsorted(rc[ti], REC_THRS, side="left")
+                valid = inds < nd
                 q = np.zeros(R)
-                pr = pr.tolist()
-                for i in range(nd - 1, 0, -1):
-                    if pr[i] > pr[i - 1]:
-                        pr[i - 1] = pr[i]
-                inds = np.searchsorted(rc, REC_THRS, side="left")
-                for ri, pi in enumerate(inds):
-                    if pi < nd:
-                        q[ri] = pr[pi]
+                q[valid] = env[ti, inds[valid]]
                 precision[ti, :, ki] = q
         return precision, recall
 
@@ -178,7 +227,7 @@ class COCOEvalBbox:
         def acc(name: str, md: int):
             key = (name, md)
             if key not in cache:
-                cache[key] = self._accumulate(AREA_RNGS[name], md)
+                cache[key] = self._accumulate(name, md)
             return cache[key]
 
         p_all, r_all = acc("all", 100)

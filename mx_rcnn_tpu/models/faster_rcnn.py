@@ -27,9 +27,7 @@ import numpy as np
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.models.heads import RCNNHead
-from mx_rcnn_tpu.models.resnet import ResNetBackbone, ResNetTopHead
 from mx_rcnn_tpu.models.rpn import RPNHead
-from mx_rcnn_tpu.models.vgg import VGGBackbone, VGGTopHead
 from mx_rcnn_tpu.ops.anchors import shifted_anchors
 from mx_rcnn_tpu.ops.losses import (
     accuracy,
@@ -61,16 +59,11 @@ class FasterRCNN(nn.Module):
                 "FPN model once implemented"
             )
         dtype = _dtype_of(cfg)
-        if cfg.network.name == "vgg":
-            self.backbone = VGGBackbone(dtype=dtype)
-            self.top_head = VGGTopHead(dtype=dtype)
-            rpn_in = 512
-        else:
-            self.backbone = ResNetBackbone(depth=cfg.network.depth, dtype=dtype)
-            self.top_head = ResNetTopHead(depth=cfg.network.depth, dtype=dtype)
-            rpn_in = 512
+        from mx_rcnn_tpu.models.stage_models import build_backbone
+
+        self.backbone, self.top_head = build_backbone(cfg, dtype)
         self.rpn = RPNHead(
-            num_anchors=cfg.network.NUM_ANCHORS, channels=rpn_in, dtype=dtype
+            num_anchors=cfg.network.NUM_ANCHORS, channels=512, dtype=dtype
         )
         self.rcnn = RCNNHead(num_classes=cfg.dataset.NUM_CLASSES, dtype=dtype)
         if cfg.network.USE_MASK:
@@ -112,9 +105,12 @@ class FasterRCNN(nn.Module):
         gt_boxes: Optional[jnp.ndarray] = None,
         gt_valid: Optional[jnp.ndarray] = None,
         train: bool = False,
+        sample_seeds: Optional[jnp.ndarray] = None,
     ):
         if train:
-            return self.train_forward(images, im_info, gt_boxes, gt_valid)
+            return self.train_forward(
+                images, im_info, gt_boxes, gt_valid, sample_seeds
+            )
         return self.test_forward(images, im_info)
 
     # ------------------------------------------------------------------ train
@@ -124,6 +120,7 @@ class FasterRCNN(nn.Module):
         im_info: jnp.ndarray,
         gt_boxes: jnp.ndarray,
         gt_valid: jnp.ndarray,
+        sample_seeds: Optional[jnp.ndarray] = None,
     ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
         cfg = self.cfg
         t = cfg.TRAIN
@@ -134,7 +131,16 @@ class FasterRCNN(nn.Module):
         anchors = self._anchors(feat.shape[1], feat.shape[2])
 
         key = self.make_rng("sampling")
-        keys = jax.random.split(key, (b, 2))
+        # per-image keys from batch-supplied seeds when available: sampling
+        # then depends only on (step rng, image id), so any device topology
+        # (1 chip × batch B or B chips × batch 1) draws identical samples —
+        # the property the DP-equivalence test asserts exactly
+        if sample_seeds is not None:
+            keys = jax.vmap(
+                lambda s: jax.random.split(jax.random.fold_in(key, s), 2)
+            )(sample_seeds)
+        else:
+            keys = jax.random.split(key, (b, 2))
 
         # --- RPN anchor targets (reference: rcnn/io/rpn.py :: assign_anchor)
         atgt = jax.vmap(
@@ -198,6 +204,9 @@ class FasterRCNN(nn.Module):
             "RCNNL1Loss": rcnn_bbox_loss,
             "num_fg_rois": (labels > 0).sum(),
             "num_valid_props": props.valid.sum(),
+            # zero when the image is smaller than every anchor (RPN loss
+            # silently contributes nothing) — watch this on tiny inputs
+            "num_fg_anchors": (atgt.labels == 1).sum(),
         }
         return total, aux
 

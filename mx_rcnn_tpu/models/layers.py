@@ -54,12 +54,19 @@ def conv(
     use_bias: bool = False,
     dilation: int = 1,
 ) -> nn.Conv:
-    """3x3/1x1/7x7 conv helper, SAME padding, NHWC, f32 params."""
+    """3x3/1x1/7x7 conv helper, NHWC, f32 params.
+
+    Padding is explicit symmetric ``(k-1)//2`` — identical to SAME at
+    stride 1, but at stride 2 SAME pads (0, 1) while every public
+    ResNet/VGG checkpoint family (caffe/torch) pads symmetrically; the
+    explicit form keeps imported pretrained weights spatially aligned.
+    """
+    pad = dilation * (kernel - 1) // 2
     return nn.Conv(
         features,
         (kernel, kernel),
         strides=(stride, stride),
-        padding="SAME",
+        padding=((pad, pad), (pad, pad)),
         use_bias=use_bias,
         kernel_dilation=(dilation, dilation),
         dtype=dtype,

@@ -71,8 +71,12 @@ def make_parallel_train_step(model, tx, mesh: Mesh):
         out_specs=(state_spec, state_spec),
     )
     def sharded_step(state: TrainState, batch, rng):
-        # decorrelate sampling across chips (each chip holds different images)
-        rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+        # sampling decorrelation across chips: batches carrying per-image
+        # sample_seeds decorrelate by construction (and identically to a
+        # single-chip run — the DP-equivalence invariant); seedless batches
+        # fall back to folding in the chip index
+        if "sample_seeds" not in batch:
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
         return inner(state, batch, rng)
 
     return jax.jit(sharded_step, donate_argnums=(0,))

@@ -1,0 +1,142 @@
+"""Single-image detection demo with visualization.
+
+Reference: ``demo.py :: demo_net/vis`` — load a checkpoint, run one image
+through the test graph, per-class NMS, render class-colored boxes.
+
+Example:
+  python -m mx_rcnn_tpu.tools.demo --network resnet --params final.pkl \
+      --image photo.jpg --out demo_out.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import Config, generate_config
+from mx_rcnn_tpu.core.tester import Predictor, im_detect
+from mx_rcnn_tpu.data.image import load_image
+from mx_rcnn_tpu.data.loader import make_batch
+from mx_rcnn_tpu.models import FasterRCNN
+from mx_rcnn_tpu.ops.nms import nms_numpy
+from mx_rcnn_tpu.utils.visualize import draw_detections, save_image
+
+logger = logging.getLogger(__name__)
+
+# VOC class names for the default 21-class config (demo labels)
+VOC_CLASSES = (
+    "__background__", "aeroplane", "bicycle", "bird", "boat", "bottle",
+    "bus", "car", "cat", "chair", "cow", "diningtable", "dog", "horse",
+    "motorbike", "person", "pottedplant", "sheep", "sofa", "train",
+    "tvmonitor",
+)
+
+
+def demo_net(
+    predictor: Predictor,
+    im: np.ndarray,
+    cfg: Config,
+    class_names=VOC_CLASSES,
+    vis_thresh: float = 0.7,
+):
+    """One image → {class_name: (n, 5) dets}.  ``im`` is RGB HWC uint8/f32."""
+    rec = {
+        "image": "demo://0",
+        "height": im.shape[0],
+        "width": im.shape[1],
+        "boxes": np.zeros((0, 4), np.float32),
+        "gt_classes": np.zeros((0,), np.int32),
+        "flipped": False,
+    }
+    from mx_rcnn_tpu.data.loader import _orientation_bucket
+
+    bucket = _orientation_bucket(rec, cfg.SHAPE_BUCKETS)
+    batch = make_batch([rec], cfg, bucket, images=[im])
+    out = predictor.predict(batch)
+    det = im_detect(out, batch["im_info"][0], (im.shape[0], im.shape[1]))
+    scores, boxes = det["scores"], det["boxes"]
+    dets_by_class = {}
+    for j in range(1, len(class_names)):
+        keep = np.where(scores[:, j] > cfg.TEST.SCORE_THRESH)[0]
+        cls_dets = np.hstack(
+            [boxes[keep, j * 4 : (j + 1) * 4], scores[keep, j : j + 1]]
+        ).astype(np.float32)
+        cls_dets = cls_dets[nms_numpy(cls_dets, cfg.TEST.NMS)]
+        if (cls_dets[:, 4] >= vis_thresh).any():
+            dets_by_class[class_names[j]] = cls_dets
+    return dets_by_class
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, force=True)
+    p = argparse.ArgumentParser(description="Single-image demo")
+    p.add_argument("--network", default="resnet",
+                   choices=["vgg", "resnet", "resnet50"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "PascalVOC0712", "coco"])
+    p.add_argument("--image", required=True)
+    p.add_argument("--out", default="demo_out.png")
+    p.add_argument("--prefix", default="model/e2e")
+    p.add_argument("--epoch", type=int, default=None)
+    p.add_argument("--params", default=None, help="params pickle")
+    p.add_argument("--vis_thresh", type=float, default=0.7)
+    args = p.parse_args()
+
+    from mx_rcnn_tpu.utils.run_meta import apply_run_meta, load_run_meta
+
+    cfg = generate_config(args.network, args.dataset)
+    meta = load_run_meta(args.params if args.params else args.prefix)
+    if meta:
+        cfg = apply_run_meta(cfg, meta)
+        logger.info("applied run_meta overrides: %s", meta)
+    model = FasterRCNN(cfg)
+    h, w = cfg.SHAPE_BUCKETS[0]
+    params = model.init(
+        {"params": jax.random.key(0)},
+        np.zeros((1, h, w, 3), np.float32),
+        np.array([[h, w, 1.0]], np.float32),
+        train=False,
+    )["params"]
+    if args.params:
+        from mx_rcnn_tpu.utils.combine_model import load_params
+
+        params = load_params(args.params)
+    else:
+        from mx_rcnn_tpu.core.checkpoint import (
+            latest_epoch,
+            load_checkpoint,
+        )
+        from mx_rcnn_tpu.core.train import create_train_state, make_optimizer
+
+        epoch = args.epoch if args.epoch is not None else latest_epoch(args.prefix)
+        if epoch is not None:
+            tx = make_optimizer(cfg, lambda s: 0.0)
+            state = load_checkpoint(
+                args.prefix, epoch, create_train_state(params, tx)
+            )
+            params = state.params
+        else:
+            logger.warning("no checkpoint — running random init")
+
+    predictor = Predictor(model, params)
+    im = load_image(args.image)
+    names = (
+        VOC_CLASSES if cfg.dataset.NUM_CLASSES == len(VOC_CLASSES)
+        else tuple(f"class{i}" for i in range(cfg.dataset.NUM_CLASSES))
+    )
+    dets = demo_net(predictor, im, cfg, names, args.vis_thresh)
+    for name, d in dets.items():
+        for row in d:
+            if row[4] >= args.vis_thresh:
+                logger.info("%s %.3f @ (%.0f, %.0f, %.0f, %.0f)",
+                            name, row[4], *row[:4])
+    overlay = draw_detections(im, dets, args.vis_thresh)
+    save_image(args.out, overlay)
+    logger.info("wrote %s", args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Re-score a saved detection dump without re-running inference.
+
+Reference: ``rcnn/tools/reeval.py`` — loads the ``all_boxes`` pickle that
+``pred_eval`` saves and calls ``imdb.evaluate_detections`` again (useful
+after changing eval parameters or to re-print results).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import pickle
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.utils.load_data import get_imdb
+
+logger = logging.getLogger(__name__)
+
+
+def reeval(imdb, detections_path: str):
+    with open(detections_path, "rb") as f:
+        all_boxes = pickle.load(f)
+    assert len(all_boxes) == imdb.num_classes, (
+        f"detection dump has {len(all_boxes)} classes, imdb has "
+        f"{imdb.num_classes}"
+    )
+    results = imdb.evaluate_detections(all_boxes)
+    logger.info("reeval results: %s", results)
+    return results
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, force=True)
+    p = argparse.ArgumentParser(description="Re-score saved detections")
+    p.add_argument("--network", default="resnet",
+                   choices=["vgg", "resnet", "resnet50"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "PascalVOC0712", "coco"])
+    p.add_argument("--image_set", default=None, help="defaults to the test set")
+    p.add_argument("--detections", required=True, help="all_boxes pickle")
+    p.add_argument("--synthetic", type=int, default=0)
+    args = p.parse_args()
+    cfg = generate_config(args.network, args.dataset)
+    image_set = args.image_set or cfg.dataset.test_image_set
+    imdb = get_imdb(cfg, image_set, synthetic_size=args.synthetic)[0]
+    reeval(imdb, args.detections)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,11 +39,26 @@ def parse_args(argv=None):
     p.add_argument("--thresh", type=float, default=None)
     p.add_argument("--synthetic", type=int, default=0)
     p.add_argument("--max_images", type=int, default=0)
+    p.add_argument("--params", default=None,
+                   help="params pickle (e.g. alternate-training final.pkl) "
+                        "instead of an orbax checkpoint")
+    p.add_argument("--dump", default=None,
+                   help="save the all_boxes pickle for tools/reeval.py")
+    p.add_argument("--vis", default=None, metavar="DIR",
+                   help="render detection overlays into DIR")
     return p.parse_args(argv)
 
 
 def test_rcnn(args):
+    from mx_rcnn_tpu.utils.run_meta import apply_run_meta, load_run_meta
+
     cfg = generate_config(args.network, args.dataset)
+    # pick up the training run's preprocessing/normalization stats
+    # (pretrained pixel stats, precomputed bbox stats) from the sidecar
+    meta = load_run_meta(args.params if args.params else args.prefix)
+    if meta:
+        cfg = apply_run_meta(cfg, meta)
+        logger.info("applied run_meta overrides: %s", meta)
     imdbs = get_imdb(
         cfg, args.image_set or cfg.dataset.test_image_set, args.synthetic
     )
@@ -64,18 +79,31 @@ def test_rcnn(args):
         np.array([[h, w, 1.0]], np.float32),
         train=False,
     )["params"]
-    epoch = args.epoch if args.epoch is not None else latest_epoch(args.prefix)
-    if epoch is not None:
-        tx = make_optimizer(cfg, lambda s: 0.0)
-        state = load_checkpoint(args.prefix, epoch, create_train_state(params, tx))
-        params = state.params
-        logger.info("loaded checkpoint epoch %d", epoch)
+    if args.params:
+        from mx_rcnn_tpu.utils.combine_model import load_params
+
+        params = load_params(args.params)
+        logger.info("loaded params pickle %s", args.params)
     else:
-        logger.warning("no checkpoint found at %s — evaluating random init", args.prefix)
+        epoch = args.epoch if args.epoch is not None else latest_epoch(args.prefix)
+        if epoch is not None:
+            tx = make_optimizer(cfg, lambda s: 0.0)
+            state = load_checkpoint(
+                args.prefix, epoch, create_train_state(params, tx)
+            )
+            params = state.params
+            logger.info("loaded checkpoint epoch %d", epoch)
+        else:
+            logger.warning(
+                "no checkpoint found at %s — evaluating random init", args.prefix
+            )
 
     predictor = Predictor(model, params)
     loader = TestLoader(roidb, cfg)
-    _, results = pred_eval(predictor, loader, imdb, cfg, thresh=args.thresh)
+    _, results = pred_eval(
+        predictor, loader, imdb, cfg, thresh=args.thresh,
+        vis=args.vis, dump_path=args.dump,
+    )
     for k, v in results.items():
         logger.info("%s: %.4f", k, v)
     return results

@@ -1,0 +1,90 @@
+"""Stage tool: RPN proposal generation + recall evaluation.
+
+Reference: ``rcnn/tools/test_rpn.py`` — runs the RPN-test graph over a
+dataset, dumps proposals to ``.pkl`` (consumed by ``train_rcnn`` /
+``load_proposal_roidb``), and reports gt recall.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config, generate_config
+from mx_rcnn_tpu.core.tester import Predictor, generate_proposals
+from mx_rcnn_tpu.data.loader import TestLoader
+from mx_rcnn_tpu.eval.recall import proposal_recall
+from mx_rcnn_tpu.models.stage_models import RPNOnly
+from mx_rcnn_tpu.utils.combine_model import load_params
+from mx_rcnn_tpu.utils.load_data import load_gt_roidb
+
+logger = logging.getLogger(__name__)
+
+
+def test_rpn(
+    cfg: Config,
+    roidb: List[Dict],
+    rpn_params: Dict,
+    dump_path: Optional[str] = None,
+) -> Tuple[List[np.ndarray], Dict[str, float]]:
+    """Generate proposals over ``roidb`` with an RPN, optionally dump
+    them, and score recall vs gt.  Returns (proposals, recalls).
+
+    Uses the TEST.PROPOSAL_* budgets (post-NMS 2000, like the reference's
+    proposal-dump settings), NOT the 300-proposal detection budget — the
+    Fast-RCNN stage trains on this pool and pads its batches to
+    TRAIN.RPN_POST_NMS_TOP_N.
+    """
+    import dataclasses
+
+    te = cfg.TEST
+    dump_cfg = cfg.replace(
+        TEST=dataclasses.replace(
+            te,
+            RPN_PRE_NMS_TOP_N=te.PROPOSAL_PRE_NMS_TOP_N,
+            RPN_POST_NMS_TOP_N=te.PROPOSAL_POST_NMS_TOP_N,
+            RPN_NMS_THRESH=te.PROPOSAL_NMS,
+        )
+    )
+    model = RPNOnly(dump_cfg)
+    predictor = Predictor(model, rpn_params)
+    loader = TestLoader(roidb, dump_cfg)
+    proposals = generate_proposals(predictor, loader, dump_cfg, dump_path=dump_path)
+    budgets = [
+        n for n in (300, 1000, 2000) if n <= te.PROPOSAL_POST_NMS_TOP_N
+    ] or [te.PROPOSAL_POST_NMS_TOP_N]
+    recalls = proposal_recall(proposals, roidb, top_ns=budgets)
+    for k, v in recalls.items():
+        logger.info("%s = %.4f", k, v)
+    return proposals, recalls
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, force=True)
+    p = argparse.ArgumentParser(description="RPN proposal dump + recall eval")
+    p.add_argument("--network", default="resnet",
+                   choices=["vgg", "resnet", "resnet50"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "PascalVOC0712", "coco"])
+    p.add_argument("--image_set", default=None)
+    p.add_argument("--params", required=True, help="RPN params pickle")
+    p.add_argument("--dump", default=None, help="proposal .pkl output")
+    p.add_argument("--synthetic", type=int, default=0)
+    p.add_argument("--cpu", type=int, default=0)
+    args = p.parse_args()
+    if args.cpu:
+        from mx_rcnn_tpu.utils.platform import force_cpu
+
+        force_cpu(args.cpu)
+    cfg = generate_config(args.network, args.dataset)
+    _, roidb = load_gt_roidb(
+        cfg, args.image_set, flip=False, synthetic_size=args.synthetic
+    )
+    test_rpn(cfg, roidb, load_params(args.params), dump_path=args.dump)
+
+
+if __name__ == "__main__":
+    main()

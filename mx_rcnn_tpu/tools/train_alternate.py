@@ -1,0 +1,165 @@
+"""4-stage alternate training (Ren et al. NIPS'15 schedule).
+
+Reference: ``train_alternate.py :: alternate_train`` —
+  1. train RPN-1 (from pretrained backbone)
+  2. generate proposals with RPN-1
+  3. train Fast-RCNN-1 on those proposals (from pretrained backbone)
+  4. train RPN-2 init from RCNN-1, shared convs frozen
+  5. regenerate proposals with RPN-2; train Fast-RCNN-2, shared frozen
+  6. combine_model(RPN-2, RCNN-2) → final joint detector params
+
+The reference passed state between stages via checkpoint files and
+proposal ``.pkl`` dumps; here stages are library calls passing param
+trees in memory, with the same artifacts (params pickle + proposal dumps)
+written for inspection/resume.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import os
+from typing import Dict, Optional
+
+from mx_rcnn_tpu.config import Config, generate_config
+from mx_rcnn_tpu.tools.test_rpn import test_rpn
+from mx_rcnn_tpu.tools.train_rcnn import train_rcnn
+from mx_rcnn_tpu.tools.train_rpn import train_rpn
+from mx_rcnn_tpu.utils.combine_model import combine_model, save_params
+from mx_rcnn_tpu.utils.load_data import attach_proposals as _attach
+from mx_rcnn_tpu.utils.load_data import load_gt_roidb
+
+logger = logging.getLogger(__name__)
+
+
+def alternate_train(
+    cfg: Config,
+    roidb,
+    *,
+    epochs_rpn: int = 8,
+    epochs_rcnn: int = 8,
+    pretrained_donor: Optional[Dict] = None,
+    out_dir: str = "model/alternate",
+    seed: int = 0,
+    max_steps: int = 0,
+) -> Dict:
+    """Run the full 4-stage schedule; returns final FasterRCNN params.
+
+    ``roidb`` must be the unflipped filtered gt roidb (flipping happens
+    after proposal attachment, per stage).  ``max_steps`` caps each
+    stage's steps (smoke runs)."""
+    os.makedirs(out_dir, exist_ok=True)
+    from mx_rcnn_tpu.data.imdb import IMDB
+
+    flip = cfg.TRAIN.FLIP
+
+    def flipped(rdb):
+        return IMDB.append_flipped_images(rdb) if flip else rdb
+
+    logger.info("=== stage 1: train RPN-1 ===")
+    rpn1 = train_rpn(
+        cfg, flipped(roidb), epochs=epochs_rpn, init_donor=pretrained_donor,
+        seed=seed, max_steps=max_steps,
+    )
+    save_params(os.path.join(out_dir, "rpn1.pkl"), rpn1)
+
+    logger.info("=== stage 2: RPN-1 proposals ===")
+    props1, rec1 = test_rpn(
+        cfg, roidb, rpn1, dump_path=os.path.join(out_dir, "proposals1.pkl")
+    )
+
+    logger.info("=== stage 3: train Fast-RCNN-1 ===")
+    rcnn1, cfg_rcnn1 = train_rcnn(
+        cfg, flipped(_attach(roidb, props1)), epochs=epochs_rcnn,
+        init_donor=pretrained_donor, seed=seed + 1, max_steps=max_steps,
+    )
+    save_params(os.path.join(out_dir, "rcnn1.pkl"), rcnn1)
+
+    logger.info("=== stage 4: train RPN-2 (shared frozen) ===")
+    rpn2 = train_rpn(
+        cfg, flipped(roidb), epochs=epochs_rpn, init_donor=rcnn1,
+        frozen_shared=True, seed=seed + 2, max_steps=max_steps,
+    )
+    save_params(os.path.join(out_dir, "rpn2.pkl"), rpn2)
+
+    logger.info("=== stage 5: RPN-2 proposals + train Fast-RCNN-2 ===")
+    props2, rec2 = test_rpn(
+        cfg, roidb, rpn2, dump_path=os.path.join(out_dir, "proposals2.pkl")
+    )
+    rcnn2, cfg_rcnn2 = train_rcnn(
+        cfg, flipped(_attach(roidb, props2)), epochs=epochs_rcnn,
+        init_donor=rpn2, frozen_shared=True, seed=seed + 3,
+        max_steps=max_steps,
+    )
+    save_params(os.path.join(out_dir, "rcnn2.pkl"), rcnn2)
+
+    logger.info("=== stage 6: combine ===")
+    final = combine_model(rpn2, rcnn2)
+    save_params(os.path.join(out_dir, "final.pkl"), final)
+    # eval must reuse the stats RCNN-2 trained with: the run_meta sidecar
+    # is auto-loaded by tools/test.py --params <out_dir>/final.pkl
+    from mx_rcnn_tpu.utils.run_meta import save_run_meta
+
+    save_run_meta(out_dir, cfg_rcnn2)
+    logger.info(
+        "alternate training done; recalls stage2=%s stage5=%s", rec1, rec2
+    )
+    return final
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, force=True)
+    p = argparse.ArgumentParser(description="4-stage alternate training")
+    p.add_argument("--network", default="resnet",
+                   choices=["vgg", "resnet", "resnet50"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "PascalVOC0712", "coco"])
+    p.add_argument("--image_set", default=None)
+    p.add_argument("--epochs_rpn", type=int, default=8)
+    p.add_argument("--epochs_rcnn", type=int, default=8)
+    p.add_argument("--out_dir", default="model/alternate")
+    p.add_argument("--pretrained", default=None)
+    p.add_argument("--synthetic", type=int, default=0)
+    p.add_argument("--max_steps", type=int, default=0,
+                   help="cap steps per stage (smoke runs)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--cpu", type=int, default=0)
+    args = p.parse_args()
+    if args.cpu:
+        from mx_rcnn_tpu.utils.platform import force_cpu
+
+        force_cpu(args.cpu)
+    cfg = generate_config(args.network, args.dataset)
+    donor = None
+    if args.pretrained:
+        from mx_rcnn_tpu.utils.pretrained import (
+            import_resnet,
+            import_vgg16,
+            load_state_dict,
+            torchvision_pixel_stats,
+        )
+
+        means, stds = torchvision_pixel_stats()
+        cfg = cfg.replace(network=dataclasses.replace(
+            cfg.network, PIXEL_MEANS=means, PIXEL_STDS=stds
+        ))
+        sd = load_state_dict(args.pretrained)
+        if cfg.network.name == "vgg":
+            backbone, top = import_vgg16(sd)
+        else:
+            backbone, top = import_resnet(sd, cfg.network.depth)
+        donor = {"backbone": backbone, "top_head": top}
+    _, roidb = load_gt_roidb(
+        cfg, args.image_set, flip=False, synthetic_size=args.synthetic
+    )
+    alternate_train(
+        cfg, roidb,
+        epochs_rpn=args.epochs_rpn, epochs_rcnn=args.epochs_rcnn,
+        pretrained_donor=donor, out_dir=args.out_dir,
+        seed=args.seed, max_steps=args.max_steps,
+    )
+
+
+if __name__ == "__main__":
+    main()

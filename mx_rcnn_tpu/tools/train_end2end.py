@@ -53,6 +53,12 @@ def parse_args(argv=None):
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--batch_images", type=int, default=None, help="per-chip batch")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--pretrained", default=None, metavar="CKPT",
+                   help="ImageNet backbone checkpoint (.pth/.npz/pickle, "
+                        "torchvision layout) imported before training")
+    p.add_argument("--compute_dtype", default=None,
+                   choices=["float32", "bfloat16"],
+                   help="override network COMPUTE_DTYPE (bf16 rides the MXU)")
     p.add_argument("--no_flip", action="store_true")
     p.add_argument("--no_shuffle", action="store_true")
     p.add_argument("--frequent", type=int, default=20, help="logging interval")
@@ -82,6 +88,20 @@ def train_net(args):
         overrides["BATCH_IMAGES"] = args.batch_images
     if overrides:
         cfg = cfg.replace(TRAIN=dataclasses.replace(cfg.TRAIN, **overrides))
+    net_overrides = {}
+    if args.compute_dtype:
+        net_overrides["COMPUTE_DTYPE"] = args.compute_dtype
+    if args.pretrained:
+        # torchvision-family checkpoints expect their own pixel stats
+        from mx_rcnn_tpu.utils.pretrained import torchvision_pixel_stats
+
+        means, stds = torchvision_pixel_stats()
+        net_overrides["PIXEL_MEANS"] = means
+        net_overrides["PIXEL_STDS"] = stds
+    if net_overrides:
+        cfg = cfg.replace(
+            network=dataclasses.replace(cfg.network, **net_overrides)
+        )
 
     n_chips = len(jax.devices())
     per_chip = cfg.TRAIN.BATCH_IMAGES
@@ -115,6 +135,16 @@ def train_net(args):
         init_batch["images"], init_batch["im_info"],
         init_batch["gt_boxes"], init_batch["gt_valid"], train=True,
     )["params"]
+    if args.pretrained:
+        # reference: load_param(pretrained) before attaching detection
+        # heads (train_end2end.py :: train_net, SURVEY App. B)
+        from mx_rcnn_tpu.utils.pretrained import apply_pretrained, load_state_dict
+
+        params = apply_pretrained(
+            jax.device_get(params), load_state_dict(args.pretrained),
+            cfg.network.name, cfg.network.depth,
+        )
+        logger.info("imported pretrained backbone from %s", args.pretrained)
 
     tx = make_optimizer(cfg, make_lr_schedule(cfg, steps_per_epoch))
     state = create_train_state(params, tx)
@@ -124,6 +154,9 @@ def train_net(args):
         if last is not None:
             state = load_checkpoint(args.prefix, last, state)
             begin_epoch = last
+            # replay the same shuffle stream a fresh run would have used
+            # at this epoch (the loader keys its RNG on seed + epoch)
+            loader.epoch = begin_epoch
             logger.info("resumed from epoch %d", last)
 
     use_mesh = n_chips > 1
@@ -133,6 +166,10 @@ def train_net(args):
         step_fn = make_parallel_train_step(model, tx, mesh)
     else:
         step_fn = make_train_step(model, tx)
+
+    from mx_rcnn_tpu.utils.run_meta import save_run_meta
+
+    save_run_meta(args.prefix, cfg)
 
     tracker = MetricTracker()
     speedo = Speedometer(global_batch, args.frequent)

@@ -1,0 +1,111 @@
+"""Stage tool: Fast-RCNN training on precomputed proposals.
+
+Reference: ``rcnn/tools/train_rcnn.py`` — ``ROIIter`` over a proposal
+roidb (``load_proposal_roidb``) + the RCNN-only symbol, with roidb-wide
+bbox-target normalization (``add_bbox_regression_targets``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+from mx_rcnn_tpu.config import Config, generate_config
+from mx_rcnn_tpu.core.fit import fit
+from mx_rcnn_tpu.models.stage_models import FastRCNN
+from mx_rcnn_tpu.utils.bbox_stats import compute_bbox_stats
+from mx_rcnn_tpu.utils.combine_model import load_params, save_params
+from mx_rcnn_tpu.utils.load_data import load_gt_roidb, load_proposal_roidb
+
+logger = logging.getLogger(__name__)
+
+
+def train_rcnn(
+    cfg: Config,
+    proposal_roidb: List[Dict],
+    *,
+    epochs: int,
+    init_donor: Optional[Dict] = None,
+    frozen_shared: bool = False,
+    seed: int = 0,
+    max_steps: int = 0,
+    frequent: int = 20,
+) -> tuple[Dict, Config]:
+    """Train Fast-RCNN on a proposal roidb; returns (params, cfg_used).
+
+    The returned config carries the roidb-precomputed BBOX_MEANS/STDS
+    (needed at eval time to de-normalize deltas consistently)."""
+    if cfg.TRAIN.BBOX_NORMALIZATION_PRECOMPUTED:
+        means, stds = compute_bbox_stats(proposal_roidb, cfg)
+        logger.info("bbox target stats: means=%s stds=%s", means, stds)
+        cfg = cfg.replace(
+            TRAIN=dataclasses.replace(cfg.TRAIN, BBOX_MEANS=means, BBOX_STDS=stds)
+        )
+    model = FastRCNN(cfg)
+    fixed = cfg.network.FIXED_PARAMS_SHARED if frozen_shared else None
+    params = fit(
+        model, cfg, proposal_roidb,
+        epochs=epochs, seed=seed, init_donor=init_donor,
+        fixed_params=fixed, max_steps=max_steps, frequent=frequent,
+        proposal_count=cfg.TRAIN.RPN_POST_NMS_TOP_N,
+    )
+    return params, cfg
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, force=True)
+    p = argparse.ArgumentParser(description="Train Fast-RCNN on proposals")
+    p.add_argument("--network", default="resnet",
+                   choices=["vgg", "resnet", "resnet50"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "PascalVOC0712", "coco"])
+    p.add_argument("--image_set", default=None)
+    p.add_argument("--proposals", required=True, help="proposal .pkl dump")
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--out", default="model/rcnn_params.pkl")
+    p.add_argument("--init", default=None, help="donor params pickle")
+    p.add_argument("--synthetic", type=int, default=0)
+    p.add_argument("--max_steps", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--cpu", type=int, default=0)
+    args = p.parse_args()
+    if args.cpu:
+        from mx_rcnn_tpu.utils.platform import force_cpu
+
+        force_cpu(args.cpu)
+    cfg = generate_config(args.network, args.dataset)
+    if args.init:
+        # inherit the donor's preprocessing stats (e.g. torchvision pixel
+        # stats if the RPN stage imported a torchvision backbone)
+        from mx_rcnn_tpu.utils.run_meta import apply_run_meta, load_run_meta
+
+        meta = load_run_meta(args.init)
+        if meta:
+            cfg = apply_run_meta(cfg, meta)
+            logger.info("applied run_meta overrides from %s", args.init)
+    # proposals align 1:1 with the unflipped filtered roidb; flip AFTER
+    # attaching them (append_flipped_images x-flips the proposal boxes too)
+    _, roidb = load_gt_roidb(
+        cfg, args.image_set, flip=False, synthetic_size=args.synthetic
+    )
+    roidb = load_proposal_roidb(roidb, args.proposals)
+    if cfg.TRAIN.FLIP:
+        from mx_rcnn_tpu.data.imdb import IMDB
+
+        roidb = IMDB.append_flipped_images(roidb)
+    donor = load_params(args.init) if args.init else None
+    params, cfg_used = train_rcnn(
+        cfg, roidb, epochs=args.epochs, init_donor=donor,
+        seed=args.seed, max_steps=args.max_steps,
+    )
+    save_params(args.out, params)
+    from mx_rcnn_tpu.utils.run_meta import save_run_meta
+
+    save_run_meta(args.out, cfg_used)
+    logger.info("saved RCNN params -> %s", args.out)
+
+
+if __name__ == "__main__":
+    main()

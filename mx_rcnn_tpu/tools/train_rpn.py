@@ -1,0 +1,104 @@
+"""Stage tool: RPN-only training.
+
+Reference: ``rcnn/tools/train_rpn.py :: train_rpn`` — AnchorLoader + the
+RPN-only symbol; used standalone and as stages 1/4 of
+``train_alternate.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Dict, Optional
+
+from mx_rcnn_tpu.config import Config, generate_config
+from mx_rcnn_tpu.core.fit import fit
+from mx_rcnn_tpu.models.stage_models import RPNOnly
+from mx_rcnn_tpu.utils.combine_model import save_params
+from mx_rcnn_tpu.utils.load_data import load_gt_roidb
+
+logger = logging.getLogger(__name__)
+
+
+def train_rpn(
+    cfg: Config,
+    roidb,
+    *,
+    epochs: int,
+    init_donor: Optional[Dict] = None,
+    frozen_shared: bool = False,
+    seed: int = 0,
+    max_steps: int = 0,
+    frequent: int = 20,
+) -> Dict:
+    """Train an RPN; returns its params {backbone, rpn}.
+
+    ``frozen_shared`` freezes FIXED_PARAMS_SHARED (stage-4 semantics:
+    shared convs pinned to the donor's weights)."""
+    model = RPNOnly(cfg)
+    fixed = cfg.network.FIXED_PARAMS_SHARED if frozen_shared else None
+    return fit(
+        model, cfg, roidb,
+        epochs=epochs, seed=seed, init_donor=init_donor,
+        fixed_params=fixed, max_steps=max_steps, frequent=frequent,
+    )
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, force=True)
+    p = argparse.ArgumentParser(description="Train RPN only")
+    p.add_argument("--network", default="resnet",
+                   choices=["vgg", "resnet", "resnet50"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "PascalVOC0712", "coco"])
+    p.add_argument("--image_set", default=None)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--out", default="model/rpn_params.pkl")
+    p.add_argument("--pretrained", default=None)
+    p.add_argument("--synthetic", type=int, default=0)
+    p.add_argument("--max_steps", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--cpu", type=int, default=0)
+    args = p.parse_args()
+    if args.cpu:
+        from mx_rcnn_tpu.utils.platform import force_cpu
+
+        force_cpu(args.cpu)
+    import dataclasses
+
+    cfg = generate_config(args.network, args.dataset)
+    donor = None
+    if args.pretrained:
+        from mx_rcnn_tpu.utils.pretrained import (
+            import_resnet,
+            import_vgg16,
+            load_state_dict,
+            torchvision_pixel_stats,
+        )
+
+        means, stds = torchvision_pixel_stats()
+        cfg = cfg.replace(network=dataclasses.replace(
+            cfg.network, PIXEL_MEANS=means, PIXEL_STDS=stds
+        ))
+        sd = load_state_dict(args.pretrained)
+        if cfg.network.name == "vgg":
+            backbone, _ = import_vgg16(sd)
+        else:
+            backbone, _ = import_resnet(sd, cfg.network.depth)
+        donor = {"backbone": backbone}
+    _, roidb = load_gt_roidb(
+        cfg, args.image_set, flip=cfg.TRAIN.FLIP, synthetic_size=args.synthetic
+    )
+    params = train_rpn(
+        cfg, roidb, epochs=args.epochs, init_donor=donor,
+        seed=args.seed, max_steps=args.max_steps,
+    )
+    save_params(args.out, params)
+    from mx_rcnn_tpu.utils.run_meta import save_run_meta
+
+    save_run_meta(args.out, cfg)
+    logger.info("saved RPN params -> %s", args.out)
+
+
+if __name__ == "__main__":
+    main()

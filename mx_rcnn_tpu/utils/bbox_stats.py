@@ -1,0 +1,87 @@
+"""Roidb-wide bbox regression target statistics (host-side precompute).
+
+Reference: ``rcnn/processing/bbox_regression.py ::
+add_bbox_regression_targets`` — for Fast-RCNN training on proposals the
+reference walks the roidb once, computes fg (IoU ≥
+BBOX_REGRESSION_THRESH) proposal→gt deltas, and normalizes stored targets
+by their dataset-wide mean/std (``TRAIN.BBOX_NORMALIZATION_PRECOMPUTED``).
+
+The TPU rebuild keeps normalization *in-graph* (``ops/targets.py ::
+sample_rois`` applies cfg BBOX_MEANS/STDS), so the precompute returns the
+stats for a config override rather than mutating the roidb.  Deviation
+from the reference, documented: stats are class-agnostic (one (4,)
+mean/std) — the in-graph normalizer is class-agnostic, matching the
+end2end mode's fixed (0.1, 0.1, 0.2, 0.2) stds convention.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+
+
+def _overlaps(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(N, 4) × (K, 4) → (N, K) IoU, +1 width convention."""
+    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
+    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    iw = np.minimum(a[:, None, 2], b[None, :, 2]) - np.maximum(
+        a[:, None, 0], b[None, :, 0]
+    ) + 1
+    ih = np.minimum(a[:, None, 3], b[None, :, 3]) - np.maximum(
+        a[:, None, 1], b[None, :, 1]
+    ) + 1
+    inter = np.clip(iw, 0, None) * np.clip(ih, 0, None)
+    return inter / (area_a[:, None] + area_b[None, :] - inter)
+
+
+def _transform(ex: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    """Box deltas (dx, dy, dw, dh), the nonlinear_transform encoding."""
+    ew = ex[:, 2] - ex[:, 0] + 1.0
+    eh = ex[:, 3] - ex[:, 1] + 1.0
+    ecx = ex[:, 0] + 0.5 * (ew - 1)
+    ecy = ex[:, 1] + 0.5 * (eh - 1)
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * (gw - 1)
+    gcy = gt[:, 1] + 0.5 * (gh - 1)
+    return np.stack(
+        [
+            (gcx - ecx) / (ew + 1e-14),
+            (gcy - ecy) / (eh + 1e-14),
+            np.log(gw / ew),
+            np.log(gh / eh),
+        ],
+        axis=1,
+    )
+
+
+def compute_bbox_stats(
+    roidb: List[Dict], cfg: Config
+) -> Tuple[Tuple[float, ...], Tuple[float, ...]]:
+    """(means, stds) of fg proposal→gt deltas across a proposal roidb.
+
+    fg = proposals with best-gt IoU ≥ TRAIN.BBOX_REGRESSION_THRESH.
+    Falls back to the config defaults when the roidb has no fg pairs.
+    """
+    thresh = cfg.TRAIN.BBOX_REGRESSION_THRESH
+    acc = []
+    for rec in roidb:
+        props = np.asarray(rec.get("proposals", ()), np.float32)
+        gts = np.asarray(rec["boxes"], np.float32)
+        if len(props) == 0 or len(gts) == 0:
+            continue
+        ov = _overlaps(props, gts)
+        best = ov.max(axis=1)
+        arg = ov.argmax(axis=1)
+        fg = best >= thresh
+        if fg.any():
+            acc.append(_transform(props[fg], gts[arg[fg]]))
+    if not acc:
+        return cfg.TRAIN.BBOX_MEANS, cfg.TRAIN.BBOX_STDS
+    deltas = np.concatenate(acc, axis=0)
+    means = deltas.mean(axis=0)
+    stds = deltas.std(axis=0) + 1e-8
+    return tuple(float(x) for x in means), tuple(float(x) for x in stds)

@@ -1,0 +1,39 @@
+"""Merge stage checkpoints into the final joint detector.
+
+Reference: ``rcnn/utils/combine_model.py :: combine_model`` — after
+alternate training, the final model takes the shared convolutions + RPN
+head from the stage-2 RPN run and the RCNN head from the stage-2 RCNN run
+(their shared convs are identical by construction: stage 2 freezes
+FIXED_PARAMS_SHARED).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict
+
+
+def combine_model(rpn_params: Dict, rcnn_params: Dict) -> Dict:
+    """RPNOnly params {backbone, rpn} + FastRCNN params
+    {backbone, top_head, rcnn} → FasterRCNN params
+    {backbone, rpn, top_head, rcnn}.
+
+    The backbone is taken from the RPN side (the proposal distribution the
+    RCNN was trained on came from exactly these weights).
+    """
+    return {
+        "backbone": rpn_params["backbone"],
+        "rpn": rpn_params["rpn"],
+        "top_head": rcnn_params["top_head"],
+        "rcnn": rcnn_params["rcnn"],
+    }
+
+
+def save_params(path: str, params: Dict) -> None:
+    with open(path, "wb") as f:
+        pickle.dump(params, f, pickle.HIGHEST_PROTOCOL)
+
+
+def load_params(path: str) -> Dict:
+    with open(path, "rb") as f:
+        return pickle.load(f)

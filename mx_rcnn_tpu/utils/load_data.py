@@ -56,11 +56,9 @@ def load_gt_roidb(
     return imdbs, filter_roidb(roidb)
 
 
-def load_proposal_roidb(roidb, proposal_path: str, top_n: int = 0):
-    """Attach dumped RPN proposals to a gt roidb for Fast-RCNN training
-    (reference: ``load_proposal_roidb`` reading the ``.pkl`` dumps)."""
-    with open(proposal_path, "rb") as f:
-        proposals = pickle.load(f)
+def attach_proposals(roidb, proposals, top_n: int = 0):
+    """Attach per-image proposal arrays to roidb records (score-descending
+    (P, ≥4) arrays; ``top_n`` > 0 keeps the best N)."""
     assert len(proposals) == len(roidb), "proposal dump / roidb mismatch"
     out = []
     for rec, props in zip(roidb, proposals):
@@ -69,3 +67,11 @@ def load_proposal_roidb(roidb, proposal_path: str, top_n: int = 0):
         rec["proposals"] = boxes.astype("float32")
         out.append(rec)
     return out
+
+
+def load_proposal_roidb(roidb, proposal_path: str, top_n: int = 0):
+    """Attach dumped RPN proposals to a gt roidb for Fast-RCNN training
+    (reference: ``load_proposal_roidb`` reading the ``.pkl`` dumps)."""
+    with open(proposal_path, "rb") as f:
+        proposals = pickle.load(f)
+    return attach_proposals(roidb, proposals, top_n)

@@ -1,0 +1,186 @@
+"""Pretrained-backbone import: public checkpoint layouts → Flax param trees.
+
+Reference: ``rcnn/utils/load_model.py :: load_param`` + the
+ImageNet-pretrained initialization in ``train_end2end.py :: train_net``
+(SURVEY App. B) — the reference *never* trains from random init; it loads
+MXNet ``vgg16-0001.params`` / ``resnet-101-0000.params`` ImageNet weights
+before attaching the detection heads.
+
+The TPU rebuild has no MXNet dependency, so the importer targets the
+checkpoint layouts a user can actually obtain: the **torchvision
+state_dict naming** for ResNet-50/101 and VGG-16 (also the layout most
+public conversions ship), loaded from ``.pth``/``.pt`` (via torch, weights
+only), ``.npz``, or a pickled ``dict``.  Our ResNet is the classic
+post-activation bottleneck in NHWC precisely so this mapping is a pure
+rename + axis transpose (see ``models/resnet.py`` docstring).
+
+Layout notes:
+- torch convs are OIHW; Flax ``nn.Conv`` kernels are HWIO → transpose
+  (2, 3, 1, 0).
+- torch BN ``weight/bias/running_mean/running_var`` →
+  :class:`FrozenBatchNorm` ``scale/bias/mean/var``.
+- ``layer4`` maps into the *top head* (our conv5/stage4 runs per-roi,
+  reference-style), not the backbone.
+- VGG fc6 consumes CHW-flattened 7×7×512 in torch but HWC-flattened in
+  NHWC Flax → un-flatten, permute, re-flatten.
+- torchvision models are trained on RGB in [0, 1] normalized by
+  mean (0.485, 0.456, 0.406) / std (0.229, 0.224, 0.225);
+  :func:`torchvision_pixel_stats` returns the equivalent 0-255 stats for
+  the config's PIXEL_MEANS/PIXEL_STDS fields.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, Tuple
+
+import numpy as np
+
+_RESNET_BLOCKS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+# torchvision feature indices of the 13 VGG-16 convs, in block order
+_VGG16_FEATURES = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28)
+_VGG16_NAMES = (
+    "conv1_1", "conv1_2", "conv2_1", "conv2_2", "conv3_1", "conv3_2",
+    "conv3_3", "conv4_1", "conv4_2", "conv4_3", "conv5_1", "conv5_2",
+    "conv5_3",
+)
+
+
+def torchvision_pixel_stats() -> Tuple[Tuple[float, ...], Tuple[float, ...]]:
+    """(PIXEL_MEANS, PIXEL_STDS) on the 0-255 RGB scale for torchvision
+    checkpoints."""
+    means = tuple(255.0 * m for m in (0.485, 0.456, 0.406))
+    stds = tuple(255.0 * s for s in (0.229, 0.224, 0.225))
+    return means, stds
+
+
+def load_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Read a checkpoint file into a flat {name: ndarray} dict.
+
+    Supports ``.npz``, pickled dicts, and torch ``.pth/.pt`` state_dicts
+    (loaded weights-only on CPU; tensors converted to numpy).
+    """
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return {k: np.asarray(z[k]) for k in z.files}
+    if path.endswith((".pth", ".pt")):
+        import torch
+
+        obj = torch.load(path, map_location="cpu", weights_only=True)
+        if hasattr(obj, "state_dict"):
+            obj = obj.state_dict()
+        return {k: v.detach().cpu().numpy() for k, v in obj.items()}
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return {k: np.asarray(v) for k, v in obj.items()}
+
+
+def _conv_kernel(w: np.ndarray) -> np.ndarray:
+    """OIHW → HWIO."""
+    return np.ascontiguousarray(np.transpose(w, (2, 3, 1, 0))).astype(np.float32)
+
+
+def _bn(sd: Dict[str, np.ndarray], prefix: str) -> Dict[str, np.ndarray]:
+    return {
+        "scale": sd[f"{prefix}.weight"].astype(np.float32),
+        "bias": sd[f"{prefix}.bias"].astype(np.float32),
+        "mean": sd[f"{prefix}.running_mean"].astype(np.float32),
+        "var": sd[f"{prefix}.running_var"].astype(np.float32),
+    }
+
+
+def _bottleneck(sd: Dict[str, np.ndarray], prefix: str) -> Dict:
+    unit = {}
+    for i in (1, 2, 3):
+        unit[f"conv{i}"] = {"kernel": _conv_kernel(sd[f"{prefix}.conv{i}.weight"])}
+        unit[f"bn{i}"] = _bn(sd, f"{prefix}.bn{i}")
+    if f"{prefix}.downsample.0.weight" in sd:
+        unit["sc"] = {"kernel": _conv_kernel(sd[f"{prefix}.downsample.0.weight"])}
+        unit["sc_bn"] = _bn(sd, f"{prefix}.downsample.1")
+    return unit
+
+
+def import_resnet(sd: Dict[str, np.ndarray], depth: int) -> Tuple[Dict, Dict]:
+    """torchvision ResNet state_dict → (backbone_params, top_head_params).
+
+    backbone = conv0/bn0 + stage1..stage3 (torch layer1..layer3);
+    top_head = stage4 (torch layer4, applied per-roi).
+    """
+    blocks = _RESNET_BLOCKS[depth]
+    backbone: Dict = {
+        "conv0": {"kernel": _conv_kernel(sd["conv1.weight"])},
+        "bn0": _bn(sd, "bn1"),
+    }
+    for stage, n_units in enumerate(blocks[:3], start=1):
+        backbone[f"stage{stage}"] = {
+            f"unit{u + 1}": _bottleneck(sd, f"layer{stage}.{u}")
+            for u in range(n_units)
+        }
+    top_head = {
+        "stage4": {
+            f"unit{u + 1}": _bottleneck(sd, f"layer4.{u}")
+            for u in range(blocks[3])
+        }
+    }
+    return backbone, top_head
+
+
+def import_vgg16(sd: Dict[str, np.ndarray]) -> Tuple[Dict, Dict]:
+    """torchvision VGG-16 state_dict → (backbone_params, top_head_params)."""
+    backbone: Dict = {}
+    for idx, name in zip(_VGG16_FEATURES, _VGG16_NAMES):
+        backbone[name] = {
+            "kernel": _conv_kernel(sd[f"features.{idx}.weight"]),
+            "bias": sd[f"features.{idx}.bias"].astype(np.float32),
+        }
+    # fc6: torch flattens (C=512, 7, 7) CHW; Flax flattens (7, 7, 512) HWC
+    w6 = sd["classifier.0.weight"]                     # (4096, 25088)
+    w6 = w6.reshape(4096, 512, 7, 7).transpose(2, 3, 1, 0).reshape(25088, 4096)
+    top_head = {
+        "fc6": {
+            "kernel": np.ascontiguousarray(w6).astype(np.float32),
+            "bias": sd["classifier.0.bias"].astype(np.float32),
+        },
+        "fc7": {
+            "kernel": np.ascontiguousarray(
+                sd["classifier.3.weight"].T
+            ).astype(np.float32),
+            "bias": sd["classifier.3.bias"].astype(np.float32),
+        },
+    }
+    return backbone, top_head
+
+
+def _merge(dst: Dict, src: Dict, path: str) -> None:
+    """Recursively overwrite dst leaves with src, asserting shape match."""
+    for k, v in src.items():
+        if k not in dst:
+            raise KeyError(f"pretrained param {path}/{k} not in model tree")
+        if isinstance(v, dict):
+            _merge(dst[k], v, f"{path}/{k}")
+        else:
+            have = np.shape(dst[k])
+            want = np.shape(v)
+            if tuple(have) != tuple(want):
+                raise ValueError(
+                    f"shape mismatch at {path}/{k}: model {have} vs import {want}"
+                )
+            dst[k] = np.asarray(v)
+
+
+def apply_pretrained(params: Dict, sd: Dict[str, np.ndarray], network: str,
+                     depth: int) -> Dict:
+    """Return a copy of a FasterRCNN param tree with backbone + top_head
+    leaves replaced by imported ImageNet weights (heads stay at their
+    Normal(0.01)/Normal(0.001) detection init, as in the reference)."""
+    import jax
+
+    if network == "vgg":
+        backbone, top_head = import_vgg16(sd)
+    else:
+        backbone, top_head = import_resnet(sd, depth)
+    out = jax.tree_util.tree_map(np.asarray, params)
+    _merge(out["backbone"], backbone, "backbone")
+    _merge(out["top_head"], top_head, "top_head")
+    return out

@@ -1,0 +1,55 @@
+"""Detection visualization: boxes + class/score overlays.
+
+Reference: ``rcnn/core/tester.py :: vis_all_detection / draw_all_detection``
+(matplotlib show / cv2 image return).  Here one cv2 renderer serves both
+the demo and the ``vis`` flag of ``pred_eval``; colors are deterministic
+per class id so overlays are comparable across images.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+
+def class_color(cls_idx: int):
+    """Deterministic bright BGR color for a class id."""
+    rng = np.random.RandomState(cls_idx * 9973 + 17)
+    c = rng.randint(64, 256, size=3)
+    return int(c[0]), int(c[1]), int(c[2])
+
+
+def draw_detections(
+    im_rgb: np.ndarray,
+    dets_by_class: Dict[str, np.ndarray],
+    thresh: float = 0.7,
+) -> np.ndarray:
+    """Render detections onto an RGB uint8 image copy.
+
+    ``dets_by_class[name]`` = (n, 5) [x1, y1, x2, y2, score] arrays in the
+    image's coordinate frame.  Returns RGB uint8.
+    """
+    import cv2
+
+    im = np.ascontiguousarray(im_rgb.astype(np.uint8))
+    for k, (name, dets) in enumerate(sorted(dets_by_class.items())):
+        color = class_color(k + 1)
+        for det in np.asarray(dets):
+            score = float(det[4])
+            if score < thresh:
+                continue
+            x1, y1, x2, y2 = (int(round(v)) for v in det[:4])
+            cv2.rectangle(im, (x1, y1), (x2, y2), color, 2)
+            label = f"{name} {score:.3f}"
+            cv2.putText(
+                im, label, (x1, max(y1 - 4, 10)),
+                cv2.FONT_HERSHEY_SIMPLEX, 0.5, color, 1, cv2.LINE_AA,
+            )
+    return im
+
+
+def save_image(path: str, im_rgb: np.ndarray) -> None:
+    import cv2
+
+    cv2.imwrite(path, cv2.cvtColor(im_rgb.astype(np.uint8), cv2.COLOR_RGB2BGR))

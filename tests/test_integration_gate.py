@@ -1,0 +1,18 @@
+"""The train→eval mAP integration gate (VERDICT r1 #3).
+
+Trains the small-shape flagship architecture on 8 synthetic images and
+runs the FULL eval stack (Predictor → im_detect → per-class NMS →
+evaluate_detections) on the same images; overfitting must reach high mAP.
+This is the only test that exercises the proposal→im_detect→eval seams
+end to end.
+"""
+
+import numpy as np
+
+from mx_rcnn_tpu.tools.integration_gate import run_gate
+
+
+def test_overfit_reaches_high_map():
+    out = run_gate(num_images=8, steps=400, eval_every=100, target=0.8)
+    assert np.isfinite(out["mAP"])
+    assert out["mAP"] >= 0.8, f"integration gate failed: {out}"

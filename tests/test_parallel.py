@@ -56,12 +56,19 @@ def test_parallel_step_runs_and_replicates():
 
 
 def test_dp_grads_match_single_device():
-    """pmean-of-shard-grads == grad of the whole batch on one device
-    (linearity of the loss mean) — the KVStore-equivalence property."""
+    """8-chip DP step == single-device step on the same global batch,
+    parameter for parameter — the exact KVStore-equivalence claim.
+
+    Per-image ``sample_seeds`` make the in-graph roi/anchor subsampling
+    identical across topologies, so the pmean of shard gradients must
+    equal the whole-batch gradient (linearity of the loss mean) and the
+    post-update params must agree to float tolerance.
+    """
     cfg = tiny_cfg()
     model = FasterRCNN(cfg)
     mesh = make_mesh()
     batch = tiny_batch(np.random.RandomState(2), b=8, h=96, w=96)
+    batch["sample_seeds"] = jnp.arange(8, dtype=jnp.int32)
     params = model.init(
         {"params": jax.random.key(0), "sampling": jax.random.key(1)},
         batch["images"][:1],
@@ -78,16 +85,15 @@ def test_dp_grads_match_single_device():
     s_step = make_train_step(model, tx, donate=False)
     s_new, s_aux = s_step(s_state, batch, jax.random.key(9))
 
-    # the parallel path decorrelates rngs per chip, so exact equality with
-    # a single-device run isn't expected; instead check the update moved
-    # params by a comparable magnitude and stayed finite everywhere
     p_state = replicate(create_train_state(params, tx), mesh)
     p_step = make_parallel_train_step(model, tx, mesh)
     p_new, p_aux = p_step(p_state, shard_batch(batch, mesh), jax.random.key(9))
 
-    p_flat = jax.tree_util.tree_leaves(p_new.params)
-    s_flat = jax.tree_util.tree_leaves(s_new.params)
-    p_norm = float(sum(jnp.sum(x.astype(jnp.float32) ** 2) for x in p_flat))
-    s_norm = float(sum(jnp.sum(x.astype(jnp.float32) ** 2) for x in s_flat))
-    assert np.isfinite(p_norm) and np.isfinite(s_norm)
-    assert abs(p_norm - s_norm) / s_norm < 0.01
+    assert np.isclose(float(p_aux["loss"]), float(s_aux["loss"]), rtol=1e-5)
+    s_flat = jax.tree_util.tree_flatten_with_path(jax.device_get(s_new.params))[0]
+    p_flat = jax.tree_util.tree_flatten_with_path(jax.device_get(p_new.params))[0]
+    for (path, sv), (_, pv) in zip(s_flat, p_flat):
+        np.testing.assert_allclose(
+            np.asarray(pv), np.asarray(sv), rtol=1e-4, atol=1e-5,
+            err_msg=f"param mismatch at {jax.tree_util.keystr(path)}",
+        )

@@ -1,0 +1,173 @@
+"""Stage graphs (RPN-only, Fast-RCNN-on-proposals) + combine_model.
+
+Reference coverage: ``get_*_rpn``/``get_*_rcnn`` symbols,
+``rcnn/core/loader.py :: ROIIter``, ``rcnn/utils/combine_model.py``.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.core.train import create_train_state, make_optimizer, make_train_step
+from mx_rcnn_tpu.models import FasterRCNN
+from mx_rcnn_tpu.models.stage_models import FastRCNN, RPNOnly
+from mx_rcnn_tpu.utils.combine_model import combine_model
+from tests.test_model import tiny_batch, tiny_cfg
+
+
+def proposal_batch(rng, cfg, b=1, h=128, w=128, p=None):
+    """tiny_batch + proposals covering/near the gt boxes."""
+    p = p or cfg.TRAIN.RPN_POST_NMS_TOP_N
+    batch = tiny_batch(rng, b, h, w)
+    props = np.zeros((b, p, 4), np.float32)
+    valid = np.zeros((b, p), bool)
+    for i in range(b):
+        # jittered copies of the gt boxes + random negatives
+        k = 0
+        for gt in np.asarray(batch["gt_boxes"][i][:2, :4]):
+            for _ in range(p // 4):
+                jit = rng.randn(4) * 4
+                props[i, k] = np.clip(gt + jit, 0, max(h, w) - 1)
+                k += 1
+        while k < p:
+            x1, y1 = rng.rand() * (w - 40), rng.rand() * (h - 40)
+            props[i, k] = [x1, y1, x1 + 10 + rng.rand() * 30, y1 + 10 + rng.rand() * 30]
+            k += 1
+        valid[i] = True
+    batch["proposals"] = jnp.asarray(props)
+    batch["prop_valid"] = jnp.asarray(valid)
+    return batch
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    c = tiny_cfg()
+    return c.replace(
+        TRAIN=dataclasses.replace(c.TRAIN, RPN_POST_NMS_TOP_N=64)
+    )
+
+
+class TestRPNOnly:
+    def test_train_and_test_forward(self, rng, cfg):
+        model = RPNOnly(cfg)
+        # 192×192: the smallest anchor (scale 8 × stride 16 = 128 px) must
+        # fit inside the border or every label is ignore and loss is 0
+        batch = tiny_batch(rng, h=192, w=192)
+        params = model.init(
+            {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+            train=True, **batch,
+        )["params"]
+        assert set(params.keys()) == {"backbone", "rpn"}
+        loss, aux = model.apply(
+            {"params": params}, train=True, rngs={"sampling": jax.random.key(2)},
+            **batch,
+        )
+        assert np.isfinite(float(loss))
+        assert float(loss) > 0
+        assert float(aux["num_fg_anchors"]) > 0
+
+        out = model.apply(
+            {"params": params}, batch["images"], batch["im_info"], train=False
+        )
+        r = cfg.TEST.RPN_POST_NMS_TOP_N
+        assert out["rois"].shape == (1, r, 4)
+        assert out["roi_valid"].shape == (1, r)
+        assert out["roi_valid"].sum() > 0
+
+    def test_loss_decreases(self, rng, cfg):
+        model = RPNOnly(cfg)
+        batch = tiny_batch(rng, h=192, w=192)
+        params = model.init(
+            {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+            train=True, **batch,
+        )["params"]
+        tx = make_optimizer(cfg, lambda s: 0.002)
+        state = create_train_state(params, tx)
+        step = make_train_step(model, tx, donate=False)
+        losses = []
+        for _ in range(15):
+            state, aux = step(state, batch, jax.random.key(7))
+            losses.append(float(aux["loss"]))
+        assert np.isfinite(losses).all()
+        assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+class TestFastRCNN:
+    def test_train_and_test_forward(self, rng, cfg):
+        model = FastRCNN(cfg)
+        batch = proposal_batch(rng, cfg)
+        params = model.init(
+            {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+            train=True, **batch,
+        )["params"]
+        assert set(params.keys()) == {"backbone", "top_head", "rcnn"}
+        loss, aux = model.apply(
+            {"params": params}, train=True, rngs={"sampling": jax.random.key(2)},
+            **batch,
+        )
+        assert np.isfinite(float(loss))
+        assert float(aux["num_fg_rois"]) > 0  # jittered gt copies are fg
+
+        out = model.apply(
+            {"params": params},
+            batch["images"], batch["im_info"],
+            proposals=batch["proposals"], prop_valid=batch["prop_valid"],
+            train=False,
+        )
+        p = batch["proposals"].shape[1]
+        k = cfg.dataset.NUM_CLASSES
+        assert out["cls_prob"].shape == (1, p, k)
+        assert out["bbox_deltas"].shape == (1, p, 4 * k)
+        np.testing.assert_allclose(
+            np.asarray(out["cls_prob"]).sum(-1), 1.0, rtol=1e-4
+        )
+
+    def test_loss_decreases(self, rng, cfg):
+        model = FastRCNN(cfg)
+        batch = proposal_batch(rng, cfg)
+        params = model.init(
+            {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+            train=True, **batch,
+        )["params"]
+        tx = make_optimizer(cfg, lambda s: 0.002)
+        state = create_train_state(params, tx)
+        step = make_train_step(model, tx, donate=False)
+        losses = []
+        for _ in range(15):
+            state, aux = step(state, batch, jax.random.key(7))
+            losses.append(float(aux["loss"]))
+        assert np.isfinite(losses).all()
+        assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+class TestCombineModel:
+    def test_combined_tree_matches_faster_rcnn(self, rng, cfg):
+        batch = tiny_batch(rng)
+        pbatch = proposal_batch(rng, cfg)
+        rpn_params = RPNOnly(cfg).init(
+            {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+            train=True, **batch,
+        )["params"]
+        rcnn_params = FastRCNN(cfg).init(
+            {"params": jax.random.key(2), "sampling": jax.random.key(3)},
+            train=True, **pbatch,
+        )["params"]
+        joint_params = FasterRCNN(cfg).init(
+            {"params": jax.random.key(4), "sampling": jax.random.key(5)},
+            train=True, **batch,
+        )["params"]
+
+        final = combine_model(
+            jax.device_get(rpn_params), jax.device_get(rcnn_params)
+        )
+        shapes = lambda t: jax.tree_util.tree_map(lambda x: tuple(np.shape(x)), t)
+        assert shapes(final) == shapes(jax.device_get(joint_params))
+
+        # the combined params run the joint test graph
+        out = FasterRCNN(cfg).apply(
+            {"params": final}, batch["images"], batch["im_info"], train=False
+        )
+        assert np.isfinite(np.asarray(out["cls_prob"])).all()
