@@ -11,7 +11,7 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
-from mx_rcnn_tpu.utils.bbox_stats import _overlaps
+from mx_rcnn_tpu.utils.bbox_stats import np_overlaps
 
 
 def proposal_recall(
@@ -38,7 +38,7 @@ def proposal_recall(
             boxes = np.asarray(props, np.float32)[:n, :4]
             if len(boxes) == 0:
                 continue
-            ov = _overlaps(gts, boxes)                 # (G, P)
+            ov = np_overlaps(gts, boxes)                 # (G, P)
             covered += int((ov.max(axis=1) >= iou_thresh).sum())
         out[f"recall@{n}"] = covered / max(total, 1)
     return out

@@ -3,3 +3,14 @@ from mx_rcnn_tpu.models.vgg import VGGBackbone, VGGTopHead
 from mx_rcnn_tpu.models.rpn import RPNHead
 from mx_rcnn_tpu.models.heads import RCNNHead
 from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN
+
+
+def build_model(cfg):
+    """Model factory: the single-level C4 graph or the FPN graph,
+    selected by the config (USE_FPN) — the registry dispatch that replaces
+    the reference's ``eval('get_' + network + '_train')`` symbol lookup."""
+    if cfg.network.USE_FPN:
+        from mx_rcnn_tpu.models.fpn import FPNFasterRCNN
+
+        return FPNFasterRCNN(cfg)
+    return FasterRCNN(cfg)

@@ -1,0 +1,433 @@
+"""Feature Pyramid Network Faster R-CNN (BASELINE config 4).
+
+No reference twin — the MXNet reference has no FPN (SURVEY §7.2 step 7
+calls this new design work).  Design follows Lin et al. CVPR'17 with
+TPU-native shape discipline throughout:
+
+- **Neck**: lateral 1×1 convs on C2..C5 + nearest top-down upsample-add +
+  3×3 smoothing → P2..P5; P6 = stride-2 maxpool of P5 (RPN only).
+- **Anchors**: one scale per level (FPN_ANCHOR_SCALES) × 3 ratios on
+  strides FPN_FEAT_STRIDES; all levels concatenated into ONE static
+  anchor table, so RPN target assignment (``assign_anchor``) is the
+  unmodified single-level code on a bigger N.
+- **Proposals**: per-level top-k (bounds work per level), then one NMS
+  over the union — fixed shapes, Pallas NMS on TPU.
+- **ROI level assignment**: k = ⌊k0 + log2(√(wh)/224)⌋ clamped to
+  [2, 5].  Rather than gathering rois per level (dynamic shapes), ROI
+  features are extracted from ALL four levels with the batched Pallas
+  ROIAlign and blended with a one-hot level mask — 4× flops on a cheap
+  op in exchange for a single fused static-shape graph.
+- **Head**: 2-fc (1024) box head (the standard FPN-RCNN head; conv5 has
+  no place once the pyramid exists).
+
+Param tree: {backbone, neck, rpn, top_head, rcnn} — backbone includes
+stage4 (C5 is part of the pyramid), so the torchvision importer maps
+layer4 into the backbone here (``import_resnet(..., fpn=True)``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.models.heads import MaskHead, RCNNHead
+from mx_rcnn_tpu.models.layers import conv
+from mx_rcnn_tpu.models.resnet import ResNetBackbone
+from mx_rcnn_tpu.models.rpn import RPNHead
+from mx_rcnn_tpu.ops.anchors import shifted_anchors
+from mx_rcnn_tpu.ops.losses import (
+    accuracy,
+    softmax_cross_entropy,
+    weighted_smooth_l1,
+)
+from mx_rcnn_tpu.ops.nms import nms
+from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
+from mx_rcnn_tpu.ops.roi_align import extract_roi_features_batched
+from mx_rcnn_tpu.ops.targets import assign_anchor, sample_rois
+
+_NEG_INF = -1e10
+
+
+def _dtype_of(cfg: Config):
+    return jnp.bfloat16 if cfg.network.COMPUTE_DTYPE == "bfloat16" else jnp.float32
+
+
+class FPNNeck(nn.Module):
+    """C2..C5 → P2..P5 (+P6 via maxpool, appended by the caller)."""
+
+    channels: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, feats: Tuple[jnp.ndarray, ...]) -> List[jnp.ndarray]:
+        c2, c3, c4, c5 = feats
+        laterals = [
+            conv(self.channels, 1, 1, self.dtype, name=f"lateral{i + 2}",
+                 use_bias=True)(c)
+            for i, c in enumerate((c2, c3, c4, c5))
+        ]
+        # top-down: nearest-neighbour upsample + add
+        outs = [laterals[3]]
+        for i in (2, 1, 0):
+            up = outs[0]
+            target = laterals[i]
+            up = jax.image.resize(
+                up, target.shape[:1] + target.shape[1:3] + up.shape[3:],
+                method="nearest",
+            )
+            outs.insert(0, target + up)
+        return [
+            conv(self.channels, 3, 1, self.dtype, name=f"post{i + 2}",
+                 use_bias=True)(p)
+            for i, p in enumerate(outs)
+        ]
+
+
+class FPNTopHead(nn.Module):
+    """2-fc box head on pooled rois: (R, 7, 7, C) → (R, 1024)."""
+
+    width: int = 1024
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, rois_feat: jnp.ndarray) -> jnp.ndarray:
+        x = rois_feat.reshape(rois_feat.shape[0], -1)
+        x = nn.Dense(self.width, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.width, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="fc2")(x)
+        return nn.relu(x)
+
+
+def roi_levels(rois: jnp.ndarray, k0: int = 4, canonical: float = 224.0,
+               lo: int = 2, hi: int = 5) -> jnp.ndarray:
+    """(…, 4) boxes → FPN level index in [lo, hi] (Lin et al. eq. 1)."""
+    w = jnp.maximum(rois[..., 2] - rois[..., 0] + 1.0, 1.0)
+    h = jnp.maximum(rois[..., 3] - rois[..., 1] + 1.0, 1.0)
+    k = jnp.floor(k0 + jnp.log2(jnp.sqrt(w * h) / canonical))
+    return jnp.clip(k, lo, hi).astype(jnp.int32)
+
+
+class FPNFasterRCNN(nn.Module):
+    """Multi-level two-stage detector; same external contract as
+    :class:`FasterRCNN` (train → (loss, aux); test → padded detections),
+    so the trainer/Predictor/eval stack is reused unchanged."""
+
+    cfg: Config
+
+    def setup(self):
+        cfg = self.cfg
+        dtype = _dtype_of(cfg)
+        self.backbone = ResNetBackbone(
+            depth=cfg.network.depth, dtype=dtype, return_pyramid=True
+        )
+        self.neck = FPNNeck(channels=cfg.network.FPN_CHANNELS, dtype=dtype)
+        # one RPN head shared across levels (FPN paper); 3 anchors/cell
+        self.rpn = RPNHead(
+            num_anchors=len(cfg.network.ANCHOR_RATIOS)
+            * len(cfg.network.FPN_ANCHOR_SCALES),
+            channels=cfg.network.FPN_CHANNELS,
+            dtype=dtype,
+        )
+        self.top_head = FPNTopHead(dtype=dtype)
+        self.rcnn = RCNNHead(num_classes=cfg.dataset.NUM_CLASSES, dtype=dtype)
+        if cfg.network.USE_MASK:
+            self.mask_head = MaskHead(
+                num_classes=cfg.dataset.NUM_CLASSES, dtype=dtype
+            )
+
+    # ----------------------------------------------------------- helpers
+    def _pyramid(self, images: jnp.ndarray) -> List[jnp.ndarray]:
+        """→ [P2, P3, P4, P5, P6]."""
+        c_feats = self.backbone(images)
+        ps = self.neck(c_feats)
+        p6 = nn.max_pool(ps[-1], (1, 1), strides=(2, 2))
+        return ps + [p6]
+
+    def _level_anchors(self, shapes) -> List[np.ndarray]:
+        net = self.cfg.network
+        return [
+            shifted_anchors(
+                h, w, stride,
+                ratios=net.ANCHOR_RATIOS, scales=net.FPN_ANCHOR_SCALES,
+            )
+            for (h, w), stride in zip(shapes, net.FPN_FEAT_STRIDES)
+        ]
+
+    def _rpn_over_levels(self, pyramid):
+        """Shared RPN on each level → concat logits/deltas + anchor table."""
+        logits, deltas = [], []
+        for p in pyramid:
+            lg, dl = self.rpn(p)               # (B, Hl*Wl*A, 2/4)
+            logits.append(lg)
+            deltas.append(dl)
+        shapes = [(p.shape[1], p.shape[2]) for p in pyramid]
+        anchors = jnp.asarray(
+            np.concatenate(self._level_anchors(shapes), axis=0)
+        )
+        bounds = np.cumsum(
+            [0] + [lg.shape[1] for lg in logits]
+        )  # python ints, static
+        return (
+            jnp.concatenate(logits, axis=1),
+            jnp.concatenate(deltas, axis=1),
+            anchors,
+            bounds,
+        )
+
+    def _propose_multilevel(
+        self, fg_scores, deltas, anchors, bounds, im_info,
+        pre_per_level, post_nms, nms_thresh, min_size,
+    ):
+        """One image: per-level top-k → union NMS → fixed post_nms set."""
+        h, w, scale = im_info[0], im_info[1], im_info[2]
+        boxes = bbox_pred(anchors, deltas)
+        boxes = clip_boxes(boxes, (h, w))
+        ms = min_size * scale
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        keep = (ws >= ms) & (hs >= ms)
+        scores = jnp.where(keep, fg_scores, _NEG_INF)
+
+        top_boxes, top_scores = [], []
+        for li in range(len(bounds) - 1):
+            s_l = scores[bounds[li]:bounds[li + 1]]
+            b_l = boxes[bounds[li]:bounds[li + 1]]
+            k = min(pre_per_level, s_l.shape[0])
+            ts, idx = jax.lax.top_k(s_l, k)
+            top_scores.append(ts)
+            top_boxes.append(b_l[idx])
+        cat_scores = jnp.concatenate(top_scores)
+        cat_boxes = jnp.concatenate(top_boxes, axis=0)
+        valid = cat_scores > _NEG_INF / 2
+        out_boxes, out_scores, out_valid = nms(
+            cat_boxes, cat_scores, nms_thresh, post_nms, valid
+        )
+        return out_boxes, out_scores, out_valid
+
+    def _roi_features(self, pyramid, rois: jnp.ndarray) -> jnp.ndarray:
+        """Masked multi-level ROIAlign: (B, R, 4) → (B*R, D)."""
+        net = self.cfg.network
+        levels = roi_levels(rois)                        # (B, R) in [2, 5]
+        pooled = None
+        for li, stride in enumerate(net.FPN_FEAT_STRIDES[:4]):  # P2..P5
+            feats = extract_roi_features_batched(
+                pyramid[li], rois, "roi_align", net.POOLED_SIZE,
+                1.0 / stride, net.ROI_SAMPLE_RATIO,
+            )                                            # (B, R, ph, pw, C)
+            mask = (levels == li + 2)[..., None, None, None]
+            contrib = jnp.where(mask, feats, 0.0)
+            pooled = contrib if pooled is None else pooled + contrib
+        b, r = pooled.shape[0], pooled.shape[1]
+        return self.top_head(pooled.reshape((b * r,) + pooled.shape[2:]))
+
+    # ------------------------------------------------------------------ api
+    def __call__(
+        self,
+        images: jnp.ndarray,
+        im_info: jnp.ndarray,
+        gt_boxes: Optional[jnp.ndarray] = None,
+        gt_valid: Optional[jnp.ndarray] = None,
+        train: bool = False,
+        sample_seeds: Optional[jnp.ndarray] = None,
+    ):
+        if train:
+            return self.train_forward(
+                images, im_info, gt_boxes, gt_valid, sample_seeds
+            )
+        return self.test_forward(images, im_info)
+
+    def train_forward(self, images, im_info, gt_boxes, gt_valid, sample_seeds=None):
+        cfg = self.cfg
+        t = cfg.TRAIN
+        b = images.shape[0]
+        pyramid = self._pyramid(images)
+        rpn_logits, rpn_deltas, anchors, bounds = self._rpn_over_levels(pyramid)
+
+        key = self.make_rng("sampling")
+        if sample_seeds is not None:
+            keys = jax.vmap(
+                lambda s: jax.random.split(jax.random.fold_in(key, s), 2)
+            )(sample_seeds)
+        else:
+            keys = jax.random.split(key, (b, 2))
+
+        atgt = jax.vmap(
+            lambda gtb, gtv, info, k: assign_anchor(
+                anchors, gtb[:, :4], gtv, info, k, cfg
+            )
+        )(gt_boxes, gt_valid, im_info, keys[:, 0])
+
+        fg_scores = jax.nn.softmax(rpn_logits, axis=-1)[..., 1]
+        n_levels = len(bounds) - 1
+        pre_per_level = max(t.RPN_PRE_NMS_TOP_N // n_levels, 256)
+        prop_boxes, prop_scores, prop_valid = jax.vmap(
+            lambda s, d, info: self._propose_multilevel(
+                s, d, anchors, bounds, info, pre_per_level,
+                t.RPN_POST_NMS_TOP_N, t.RPN_NMS_THRESH, t.RPN_MIN_SIZE,
+            )
+        )(
+            jax.lax.stop_gradient(fg_scores),
+            jax.lax.stop_gradient(rpn_deltas),
+            im_info,
+        )
+
+        samples = jax.vmap(
+            lambda r, rv, gtb, gtv, k: sample_rois(r, rv, gtb, gtv, k, cfg)
+        )(prop_boxes, prop_valid, gt_boxes, gt_valid, keys[:, 1])
+
+        trunk = self._roi_features(pyramid, samples.rois)
+        cls_logits, bbox_pred_out = self.rcnn(trunk)
+        labels = samples.labels.reshape(-1)
+        bbox_targets = samples.bbox_targets.reshape(bbox_pred_out.shape)
+        bbox_weights = samples.bbox_weights.reshape(bbox_pred_out.shape)
+
+        rpn_norm = float(t.RPN_BATCH_SIZE * b)
+        rcnn_norm = float(t.BATCH_ROIS * b)
+        rpn_cls_loss = softmax_cross_entropy(
+            rpn_logits.reshape(-1, 2), atgt.labels.reshape(-1), -1, rpn_norm
+        )
+        rpn_bbox_loss = weighted_smooth_l1(
+            rpn_deltas.reshape(-1, 4),
+            atgt.bbox_targets.reshape(-1, 4),
+            atgt.bbox_weights.reshape(-1, 4),
+            sigma=3.0,
+            norm=rpn_norm,
+        )
+        rcnn_cls_loss = softmax_cross_entropy(cls_logits, labels, -1, rcnn_norm)
+        rcnn_bbox_loss = weighted_smooth_l1(
+            bbox_pred_out, bbox_targets, bbox_weights, sigma=1.0, norm=rcnn_norm
+        )
+        total = rpn_cls_loss + rpn_bbox_loss + rcnn_cls_loss + rcnn_bbox_loss
+
+        aux = {
+            "RPNAcc": accuracy(rpn_logits.reshape(-1, 2), atgt.labels.reshape(-1)),
+            "RPNLogLoss": rpn_cls_loss,
+            "RPNL1Loss": rpn_bbox_loss,
+            "RCNNAcc": accuracy(cls_logits, labels),
+            "RCNNLogLoss": rcnn_cls_loss,
+            "RCNNL1Loss": rcnn_bbox_loss,
+            "num_fg_rois": (labels > 0).sum(),
+            "num_valid_props": prop_valid.sum(),
+            "num_fg_anchors": (atgt.labels == 1).sum(),
+        }
+
+        if cfg.network.USE_MASK:
+            mask_loss, mask_aux = self._mask_loss(
+                pyramid, samples, gt_boxes, gt_valid
+            )
+            total = total + mask_loss
+            aux.update(mask_aux)
+        return total, aux
+
+    def test_forward(self, images, im_info):
+        cfg = self.cfg
+        te = cfg.TEST
+        b = images.shape[0]
+        k = cfg.dataset.NUM_CLASSES
+        pyramid = self._pyramid(images)
+        rpn_logits, rpn_deltas, anchors, bounds = self._rpn_over_levels(pyramid)
+        fg_scores = jax.nn.softmax(rpn_logits, axis=-1)[..., 1]
+        n_levels = len(bounds) - 1
+        pre_per_level = max(te.RPN_PRE_NMS_TOP_N // n_levels, 256)
+        rois, roi_scores, roi_valid = jax.vmap(
+            lambda s, d, info: self._propose_multilevel(
+                s, d, anchors, bounds, info, pre_per_level,
+                te.RPN_POST_NMS_TOP_N, te.RPN_NMS_THRESH, te.RPN_MIN_SIZE,
+            )
+        )(fg_scores, rpn_deltas, im_info)
+
+        trunk = self._roi_features(pyramid, rois)
+        cls_logits, bbox_deltas = self.rcnn(trunk)
+        r = te.RPN_POST_NMS_TOP_N
+        means = jnp.tile(jnp.asarray(cfg.TRAIN.BBOX_MEANS, jnp.float32), k)
+        stds = jnp.tile(jnp.asarray(cfg.TRAIN.BBOX_STDS, jnp.float32), k)
+        bbox_deltas = bbox_deltas * stds[None, :] + means[None, :]
+        out = {
+            "rois": rois,
+            "roi_scores": roi_scores,
+            "roi_valid": roi_valid,
+            "cls_prob": jax.nn.softmax(cls_logits).reshape(b, r, k),
+            "bbox_deltas": bbox_deltas.reshape(b, r, 4 * k),
+        }
+        if cfg.network.USE_MASK:
+            out["mask_logits"] = self._mask_forward(pyramid, rois)
+        return out
+
+    # ------------------------------------------------------------- mask head
+    def _mask_pooled(self, pyramid, rois):
+        """(B, R, 4) → (B*R, 14, 14, C) mask-branch roi features."""
+        net = self.cfg.network
+        levels = roi_levels(rois)
+        pooled = None
+        for li, stride in enumerate(net.FPN_FEAT_STRIDES[:4]):
+            feats = extract_roi_features_batched(
+                pyramid[li], rois, "roi_align", (14, 14),
+                1.0 / stride, net.ROI_SAMPLE_RATIO,
+            )
+            mask = (levels == li + 2)[..., None, None, None]
+            contrib = jnp.where(mask, feats, 0.0)
+            pooled = contrib if pooled is None else pooled + contrib
+        b, r = pooled.shape[0], pooled.shape[1]
+        return pooled.reshape((b * r,) + pooled.shape[2:])
+
+    def _mask_forward(self, pyramid, rois):
+        """→ (B, R, 28, 28, K) per-class mask logits."""
+        b, r = rois.shape[0], rois.shape[1]
+        logits = self.mask_head(self._mask_pooled(pyramid, rois))
+        return logits.reshape((b, r) + logits.shape[1:])
+
+    def _mask_loss(self, pyramid, samples, gt_boxes, gt_valid):
+        """Per-fg-roi BCE against gt masks cropped to the roi (28×28).
+
+        Synthetic-gt convention (no polygon masks in this pipeline yet):
+        the gt "mask" of a box is its full rectangle, so the target is the
+        intersection of the matched gt box with the roi, rasterized on the
+        roi's 28×28 grid.  Real datasets supply ``gt_masks`` through the
+        same hook once polygon decoding lands.
+        """
+        from mx_rcnn_tpu.ops.mask_targets import rasterize_box_masks
+
+        cfg = self.cfg
+        b, r = samples.rois.shape[0], samples.rois.shape[1]
+        size = cfg.TRAIN.MASK_SIZE
+        logits = self.mask_head(self._mask_pooled(pyramid, samples.rois))
+        logits = logits.reshape(b, r, size, size, -1)
+
+        # target: matched gt box ∩ roi on the roi grid
+        fg = samples.labels > 0                                   # (B, R)
+        targets = jax.vmap(
+            lambda rois_i, gtb, gtv: rasterize_box_masks(
+                rois_i, samples_matched_gt(rois_i, gtb, gtv), size
+            )
+        )(samples.rois, gt_boxes, gt_valid)                       # (B, R, S, S)
+
+        cls = jnp.clip(samples.labels, 0)                         # (B, R)
+        sel = jnp.take_along_axis(
+            logits, cls[..., None, None, None], axis=-1
+        )[..., 0]                                                 # (B, R, S, S)
+        bce = optax_sigmoid_bce(sel, targets)
+        per_roi = bce.mean(axis=(-1, -2))                         # (B, R)
+        loss = (per_roi * fg).sum() / jnp.maximum(fg.sum(), 1.0)
+        return loss, {"MaskBCELoss": loss}
+
+
+def samples_matched_gt(rois, gt_boxes, gt_valid):
+    """Best-IoU gt box per roi (the mask target source)."""
+    from mx_rcnn_tpu.ops.boxes import bbox_overlaps
+
+    ov = bbox_overlaps(rois, gt_boxes[:, :4])
+    ov = jnp.where(gt_valid[None, :], ov, -1.0)
+    return gt_boxes[ov.argmax(axis=1), :4]
+
+
+def optax_sigmoid_bce(logits, labels):
+    return jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits))
+    )

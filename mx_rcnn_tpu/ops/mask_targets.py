@@ -1,0 +1,37 @@
+"""Mask target rasterization (Mask R-CNN extension).
+
+No reference twin (the MXNet reference has no mask path; SURVEY N5 covers
+only the eval-side RLE API).  Targets are produced fully in-graph on
+fixed shapes: for each roi, the matched gt region is rasterized onto the
+roi's S×S grid by cell-center inclusion testing — the box-mask special
+case of the general "crop gt mask to roi and resize" op.  Polygon/RLE gt
+masks plug in upstream by rasterizing to boxes' bitmaps on host and
+passing them through the same crop-resize (future work, gated on real
+COCO masks being on disk).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rasterize_box_masks(
+    rois: jnp.ndarray, gt_boxes: jnp.ndarray, size: int
+) -> jnp.ndarray:
+    """(R, 4) rois × (R, 4) matched gt boxes → (R, S, S) {0,1} targets.
+
+    Cell (i, j) of a roi's S×S grid is foreground iff its center lies
+    inside the matched gt box (the intersection rasterized in roi
+    coordinates).
+    """
+    x1, y1, x2, y2 = rois[:, 0], rois[:, 1], rois[:, 2], rois[:, 3]
+    # +1 pixel convention ([0, 13] covers 14 pixels), cell centers offset
+    # -0.5 so integer coordinates are pixel centers
+    w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+    h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+    fr = (jnp.arange(size, dtype=jnp.float32) + 0.5) / size
+    cx = x1[:, None] + fr[None, :] * w[:, None] - 0.5
+    cy = y1[:, None] + fr[None, :] * h[:, None] - 0.5
+    inside_x = (cx >= gt_boxes[:, None, 0]) & (cx <= gt_boxes[:, None, 2])
+    inside_y = (cy >= gt_boxes[:, None, 1]) & (cy <= gt_boxes[:, None, 3])
+    return (inside_y[:, :, None] & inside_x[:, None, :]).astype(jnp.float32)

@@ -135,10 +135,27 @@ def _cblk(c: int, largest: int = 512) -> int:
     return c
 
 
+_VMEM_BUDGET = 5 * 2**20  # per resident feature block (of ~16MB total)
+
+
+def fits_vmem(h: int, w: int, c: int) -> bool:
+    """True iff some channel block keeps the resident (H, W, cblk) f32
+    feature slab within the VMEM budget."""
+    return h * w * _cblk(c, largest=128) * 4 <= _VMEM_BUDGET
+
+
+def _cblk_fit(h: int, w: int, c: int, largest: int) -> int:
+    """Largest channel block whose (H, W, cblk) f32 slab fits the budget."""
+    blk = _cblk(c, largest)
+    while blk > 128 and h * w * blk * 4 > _VMEM_BUDGET:
+        blk //= 2
+    return blk
+
+
 def _roi_align_fwd_impl(feat, rois, pooled, scale, s, interpret):
     b, hf, wf, c = feat.shape
     r = rois.shape[1]
-    cblk = _cblk(c)
+    cblk = _cblk_fit(hf, wf, c, largest=512)
     grid = (b, c // cblk, r)
     kernel = partial(_fwd_kernel, pooled=pooled, s=s, scale=scale)
     return pl.pallas_call(
@@ -165,9 +182,9 @@ def _roi_align_fwd_impl(feat, rois, pooled, scale, s, interpret):
 def _roi_align_bwd_impl(feat_shape, feat_dtype, rois, g, pooled, scale, s, interpret):
     b, hf, wf, c = feat_shape
     r = rois.shape[1]
-    # 256: the f32 accumulator block + its transpose scratch must fit the
-    # 16MB scoped-VMEM budget (512 OOMs at 600x1000/stride-16 shapes)
-    cblk = _cblk(c, largest=256)
+    # 256 cap: the f32 accumulator block + its transpose scratch must fit
+    # the scoped-VMEM budget (512 OOMs at 600x1000/stride-16 shapes)
+    cblk = _cblk_fit(hf, wf, c, largest=256)
     grid = (b, c // cblk, r)
     kernel = partial(_bwd_kernel, pooled=pooled, s=s, scale=scale)
     out = pl.pallas_call(

@@ -174,7 +174,17 @@ def extract_roi_features_batched(
     """
     from mx_rcnn_tpu.utils.platform import use_pallas
 
-    if mode == "roi_align" and use_pallas():
+    # The Pallas kernel keeps one (H, W, cblk) feature block VMEM-resident;
+    # huge maps (FPN P2 at 600×1000 is 150×250) exceed the budget even at
+    # the smallest channel block — fall back to the chunked-gather path
+    # there (future work: row-blocked DMA driven by roi extents)
+    from mx_rcnn_tpu.ops.pallas.roi_align import fits_vmem
+
+    if (
+        mode == "roi_align"
+        and use_pallas()
+        and fits_vmem(feat.shape[1], feat.shape[2], feat.shape[3])
+    ):
         from mx_rcnn_tpu.ops.pallas.roi_align import roi_align_pallas
 
         return roi_align_pallas(feat, rois, pooled, spatial_scale, sample_ratio)

@@ -20,7 +20,7 @@ from mx_rcnn_tpu.config import Config, generate_config
 from mx_rcnn_tpu.core.tester import Predictor, im_detect
 from mx_rcnn_tpu.data.image import load_image
 from mx_rcnn_tpu.data.loader import make_batch
-from mx_rcnn_tpu.models import FasterRCNN
+from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.ops.nms import nms_numpy
 from mx_rcnn_tpu.utils.visualize import draw_detections, save_image
 
@@ -74,7 +74,7 @@ def main():
     logging.basicConfig(level=logging.INFO, force=True)
     p = argparse.ArgumentParser(description="Single-image demo")
     p.add_argument("--network", default="resnet",
-                   choices=["vgg", "resnet", "resnet50"])
+                   choices=["vgg", "resnet", "resnet50", "resnet_fpn", "mask_resnet_fpn"])
     p.add_argument("--dataset", default="PascalVOC",
                    choices=["PascalVOC", "PascalVOC0712", "coco"])
     p.add_argument("--image", required=True)
@@ -92,14 +92,7 @@ def main():
     if meta:
         cfg = apply_run_meta(cfg, meta)
         logger.info("applied run_meta overrides: %s", meta)
-    model = FasterRCNN(cfg)
-    h, w = cfg.SHAPE_BUCKETS[0]
-    params = model.init(
-        {"params": jax.random.key(0)},
-        np.zeros((1, h, w, 3), np.float32),
-        np.array([[h, w, 1.0]], np.float32),
-        train=False,
-    )["params"]
+    model = build_model(cfg)
     if args.params:
         from mx_rcnn_tpu.utils.combine_model import load_params
 
@@ -111,6 +104,14 @@ def main():
         )
         from mx_rcnn_tpu.core.train import create_train_state, make_optimizer
 
+        # template tree for orbax restore
+        h, w = cfg.SHAPE_BUCKETS[0]
+        params = model.init(
+            {"params": jax.random.key(0)},
+            np.zeros((1, h, w, 3), np.float32),
+            np.array([[h, w, 1.0]], np.float32),
+            train=False,
+        )["params"]
         epoch = args.epoch if args.epoch is not None else latest_epoch(args.prefix)
         if epoch is not None:
             tx = make_optimizer(cfg, lambda s: 0.0)

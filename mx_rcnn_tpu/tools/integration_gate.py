@@ -42,8 +42,13 @@ def gate_cfg(num_classes: int = 4):
         SHAPE_BUCKETS=((128, 128),),
         # anchor sizes 32/64/128 px: the flagship scales (8, 16, 32) make
         # anchors of 128-512 px, none of which fit inside a 128×128 image
-        # — every RPN label would be ignore and the RPN would never train
-        network=dataclasses.replace(cfg.network, ANCHOR_SCALES=(2, 4, 8)),
+        # — every RPN label would be ignore and the RPN would never train.
+        # FIXED_PARAMS cleared: freezing conv0/stage1/BN affines only makes
+        # sense with pretrained weights; frozen RANDOM features cap the
+        # overfit capacity this gate measures.
+        network=dataclasses.replace(
+            cfg.network, ANCHOR_SCALES=(2, 4, 8), FIXED_PARAMS=()
+        ),
         dataset=dataclasses.replace(
             cfg.dataset, NUM_CLASSES=num_classes, SCALES=((128, 128),),
             MAX_GT_BOXES=8,
@@ -94,6 +99,11 @@ def run_gate(
     loader = TrainLoader(
         roidb, cfg, cfg.TRAIN.BATCH_IMAGES, shuffle=True, seed=seed
     )
+    if len(loader) == 0:
+        raise ValueError(
+            f"num_images={num_images} yields zero batches at "
+            f"BATCH_IMAGES={cfg.TRAIN.BATCH_IMAGES}"
+        )
     batch0 = next(iter(loader))
     params = model.init(
         {"params": jax.random.key(seed), "sampling": jax.random.key(seed + 1)},

@@ -21,7 +21,7 @@ from mx_rcnn_tpu.core.checkpoint import latest_epoch, load_checkpoint
 from mx_rcnn_tpu.core.tester import Predictor, pred_eval
 from mx_rcnn_tpu.core.train import create_train_state, make_optimizer
 from mx_rcnn_tpu.data.loader import TestLoader
-from mx_rcnn_tpu.models import FasterRCNN
+from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.utils.load_data import get_imdb
 
 logger = logging.getLogger(__name__)
@@ -69,22 +69,23 @@ def test_rcnn(args):
         roidb = roidb[: args.max_images]
         imdb.image_set_index = imdb.image_set_index[: args.max_images]
 
-    model = FasterRCNN(cfg)
+    model = build_model(cfg)
     import numpy as np
 
-    h, w = cfg.SHAPE_BUCKETS[0]
-    params = model.init(
-        {"params": jax.random.key(0)},
-        np.zeros((1, h, w, 3), np.float32),
-        np.array([[h, w, 1.0]], np.float32),
-        train=False,
-    )["params"]
     if args.params:
         from mx_rcnn_tpu.utils.combine_model import load_params
 
         params = load_params(args.params)
         logger.info("loaded params pickle %s", args.params)
     else:
+        # a template tree is only needed to restore an orbax checkpoint
+        h, w = cfg.SHAPE_BUCKETS[0]
+        params = model.init(
+            {"params": jax.random.key(0)},
+            np.zeros((1, h, w, 3), np.float32),
+            np.array([[h, w, 1.0]], np.float32),
+            train=False,
+        )["params"]
         epoch = args.epoch if args.epoch is not None else latest_epoch(args.prefix)
         if epoch is not None:
             tx = make_optimizer(cfg, lambda s: 0.0)

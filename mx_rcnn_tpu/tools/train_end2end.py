@@ -29,7 +29,7 @@ from mx_rcnn_tpu.core.train import (
     make_train_step,
 )
 from mx_rcnn_tpu.data.loader import TrainLoader
-from mx_rcnn_tpu.models import FasterRCNN
+from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.parallel import (
     make_mesh,
     make_parallel_train_step,
@@ -122,7 +122,7 @@ def train_net(args):
     )
     steps_per_epoch = max(len(loader), 1)
 
-    model = FasterRCNN(cfg)
+    model = build_model(cfg)
     h, w = cfg.SHAPE_BUCKETS[0]
     init_batch = {
         "images": np.zeros((1, h, w, 3), np.float32),
@@ -142,7 +142,7 @@ def train_net(args):
 
         params = apply_pretrained(
             jax.device_get(params), load_state_dict(args.pretrained),
-            cfg.network.name, cfg.network.depth,
+            cfg.network.name, cfg.network.depth, fpn=cfg.network.USE_FPN,
         )
         logger.info("imported pretrained backbone from %s", args.pretrained)
 

@@ -23,8 +23,13 @@ import numpy as np
 from mx_rcnn_tpu.config import Config
 
 
-def _overlaps(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    """(N, 4) × (K, 4) → (N, K) IoU, +1 width convention."""
+def np_overlaps(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(N, 4) × (K, 4) → (N, K) IoU, +1 width convention.
+
+    Host-numpy twin of ``ops.boxes.bbox_overlaps`` (tested for agreement
+    in tests/test_geometry.py) — host loops over a roidb shouldn't pay a
+    jnp dispatch per record.
+    """
     area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
     area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
     iw = np.minimum(a[:, None, 2], b[None, :, 2]) - np.maximum(
@@ -34,11 +39,12 @@ def _overlaps(a: np.ndarray, b: np.ndarray) -> np.ndarray:
         a[:, None, 1], b[None, :, 1]
     ) + 1
     inter = np.clip(iw, 0, None) * np.clip(ih, 0, None)
-    return inter / (area_a[:, None] + area_b[None, :] - inter)
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-12)
 
 
-def _transform(ex: np.ndarray, gt: np.ndarray) -> np.ndarray:
-    """Box deltas (dx, dy, dw, dh), the nonlinear_transform encoding."""
+def np_transform(ex: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    """Box deltas (dx, dy, dw, dh) — host-numpy twin of
+    ``ops.boxes.bbox_transform``, same degenerate-box clamps."""
     ew = ex[:, 2] - ex[:, 0] + 1.0
     eh = ex[:, 3] - ex[:, 1] + 1.0
     ecx = ex[:, 0] + 0.5 * (ew - 1)
@@ -51,8 +57,8 @@ def _transform(ex: np.ndarray, gt: np.ndarray) -> np.ndarray:
         [
             (gcx - ecx) / (ew + 1e-14),
             (gcy - ecy) / (eh + 1e-14),
-            np.log(gw / ew),
-            np.log(gh / eh),
+            np.log(np.maximum(gw, 1.0) / np.maximum(ew, 1e-14)),
+            np.log(np.maximum(gh, 1.0) / np.maximum(eh, 1e-14)),
         ],
         axis=1,
     )
@@ -73,12 +79,12 @@ def compute_bbox_stats(
         gts = np.asarray(rec["boxes"], np.float32)
         if len(props) == 0 or len(gts) == 0:
             continue
-        ov = _overlaps(props, gts)
+        ov = np_overlaps(props, gts)
         best = ov.max(axis=1)
         arg = ov.argmax(axis=1)
         fg = best >= thresh
         if fg.any():
-            acc.append(_transform(props[fg], gts[arg[fg]]))
+            acc.append(np_transform(props[fg], gts[arg[fg]]))
     if not acc:
         return cfg.TRAIN.BBOX_MEANS, cfg.TRAIN.BBOX_STDS
     deltas = np.concatenate(acc, axis=0)

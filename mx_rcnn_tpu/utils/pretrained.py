@@ -101,22 +101,29 @@ def _bottleneck(sd: Dict[str, np.ndarray], prefix: str) -> Dict:
     return unit
 
 
-def import_resnet(sd: Dict[str, np.ndarray], depth: int) -> Tuple[Dict, Dict]:
+def import_resnet(
+    sd: Dict[str, np.ndarray], depth: int, fpn: bool = False
+) -> Tuple[Dict, Dict]:
     """torchvision ResNet state_dict → (backbone_params, top_head_params).
 
-    backbone = conv0/bn0 + stage1..stage3 (torch layer1..layer3);
-    top_head = stage4 (torch layer4, applied per-roi).
+    C4 layout (default): backbone = conv0/bn0 + stage1..stage3; top_head =
+    stage4 (applied per-roi).  FPN layout (``fpn=True``): stage4 belongs
+    to the backbone (C5 feeds the pyramid) and the 2-fc box head has no
+    ImageNet twin → empty top_head.
     """
     blocks = _RESNET_BLOCKS[depth]
     backbone: Dict = {
         "conv0": {"kernel": _conv_kernel(sd["conv1.weight"])},
         "bn0": _bn(sd, "bn1"),
     }
-    for stage, n_units in enumerate(blocks[:3], start=1):
+    n_backbone_stages = 4 if fpn else 3
+    for stage, n_units in enumerate(blocks[:n_backbone_stages], start=1):
         backbone[f"stage{stage}"] = {
             f"unit{u + 1}": _bottleneck(sd, f"layer{stage}.{u}")
             for u in range(n_units)
         }
+    if fpn:
+        return backbone, {}
     top_head = {
         "stage4": {
             f"unit{u + 1}": _bottleneck(sd, f"layer4.{u}")
@@ -170,7 +177,7 @@ def _merge(dst: Dict, src: Dict, path: str) -> None:
 
 
 def apply_pretrained(params: Dict, sd: Dict[str, np.ndarray], network: str,
-                     depth: int) -> Dict:
+                     depth: int, fpn: bool = False) -> Dict:
     """Return a copy of a FasterRCNN param tree with backbone + top_head
     leaves replaced by imported ImageNet weights (heads stay at their
     Normal(0.01)/Normal(0.001) detection init, as in the reference)."""
@@ -179,8 +186,9 @@ def apply_pretrained(params: Dict, sd: Dict[str, np.ndarray], network: str,
     if network == "vgg":
         backbone, top_head = import_vgg16(sd)
     else:
-        backbone, top_head = import_resnet(sd, depth)
+        backbone, top_head = import_resnet(sd, depth, fpn=fpn)
     out = jax.tree_util.tree_map(np.asarray, params)
     _merge(out["backbone"], backbone, "backbone")
-    _merge(out["top_head"], top_head, "top_head")
+    if top_head:
+        _merge(out["top_head"], top_head, "top_head")
     return out

@@ -1,0 +1,201 @@
+"""FPN Faster R-CNN (BASELINE config 4) and the Mask R-CNN extension
+(config 5): neck shapes, roi-level assignment, fwd/bwd, overfit, mask
+targets/loss."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.train import create_train_state, make_optimizer, make_train_step
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.models.fpn import FPNFasterRCNN, roi_levels
+from mx_rcnn_tpu.ops.mask_targets import rasterize_box_masks
+
+
+def fpn_cfg(network="resnet_fpn", num_classes=4):
+    cfg = generate_config(network, "PascalVOC")
+    return cfg.replace(
+        SHAPE_BUCKETS=((128, 128),),
+        dataset=dataclasses.replace(
+            cfg.dataset, NUM_CLASSES=num_classes, SCALES=((128, 128),),
+            MAX_GT_BOXES=4,
+        ),
+        TRAIN=dataclasses.replace(
+            cfg.TRAIN,
+            RPN_PRE_NMS_TOP_N=400,
+            RPN_POST_NMS_TOP_N=48,
+            BATCH_ROIS=16,
+            RPN_BATCH_SIZE=32,
+        ),
+        TEST=dataclasses.replace(
+            cfg.TEST, RPN_PRE_NMS_TOP_N=200, RPN_POST_NMS_TOP_N=24
+        ),
+    )
+
+
+def fpn_batch(rng, b=1, h=128, w=128):
+    images = rng.rand(b, h, w, 3).astype(np.float32)
+    im_info = np.tile([h, w, 1.0], (b, 1)).astype(np.float32)
+    gt = np.zeros((b, 4, 5), np.float32)
+    gv = np.zeros((b, 4), bool)
+    for i in range(b):
+        gt[i, 0] = [10, 10, 70, 70, 1]
+        gt[i, 1] = [50, 60, 120, 110, 2]
+        gv[i, :2] = True
+    return {
+        "images": jnp.asarray(images),
+        "im_info": jnp.asarray(im_info),
+        "gt_boxes": jnp.asarray(gt),
+        "gt_valid": jnp.asarray(gv),
+    }
+
+
+@pytest.fixture(scope="module")
+def fpn_model_and_params():
+    cfg = fpn_cfg()
+    model = build_model(cfg)
+    batch = fpn_batch(np.random.RandomState(0))
+    params = model.init(
+        {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+        train=True, **batch,
+    )["params"]
+    return cfg, model, params
+
+
+class TestRoiLevels:
+    def test_canonical_assignment(self):
+        rois = jnp.asarray([
+            [0, 0, 31, 31],        # tiny → P2
+            [0, 0, 111, 111],      # 112 ≈ 224/2 → P3
+            [0, 0, 223, 223],      # canonical 224 → P4
+            [0, 0, 447, 447],      # 448 → P5
+            [0, 0, 2000, 2000],    # huge → clamped P5
+        ], jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(roi_levels(rois)), [2, 3, 4, 5, 5]
+        )
+
+
+class TestFPNModel:
+    def test_registry_dispatch(self):
+        assert isinstance(build_model(fpn_cfg()), FPNFasterRCNN)
+
+    def test_train_forward_losses(self, fpn_model_and_params):
+        cfg, model, params = fpn_model_and_params
+        batch = fpn_batch(np.random.RandomState(1))
+        loss, aux = model.apply(
+            {"params": params}, train=True,
+            rngs={"sampling": jax.random.key(2)}, **batch,
+        )
+        assert np.isfinite(float(loss)) and float(loss) > 0
+        assert float(aux["num_fg_anchors"]) > 0, "FPN anchors must hit gts"
+        assert float(aux["num_fg_rois"]) > 0
+
+    def test_test_forward_shapes(self, fpn_model_and_params):
+        cfg, model, params = fpn_model_and_params
+        batch = fpn_batch(np.random.RandomState(1))
+        out = model.apply(
+            {"params": params}, batch["images"], batch["im_info"], train=False
+        )
+        r = cfg.TEST.RPN_POST_NMS_TOP_N
+        k = cfg.dataset.NUM_CLASSES
+        assert out["rois"].shape == (1, r, 4)
+        assert out["cls_prob"].shape == (1, r, k)
+        assert out["bbox_deltas"].shape == (1, r, 4 * k)
+        assert out["roi_valid"].sum() > 0
+        np.testing.assert_allclose(
+            np.asarray(out["cls_prob"]).sum(-1), 1.0, rtol=1e-4
+        )
+
+    def test_gradients_flow_to_all_subtrees(self, fpn_model_and_params):
+        cfg, model, params = fpn_model_and_params
+        batch = fpn_batch(np.random.RandomState(2))
+
+        def loss_fn(p):
+            loss, _ = model.apply(
+                {"params": p}, train=True,
+                rngs={"sampling": jax.random.key(3)}, **batch,
+            )
+            return loss
+
+        grads = jax.grad(loss_fn)(params)
+        for sub in ("backbone", "neck", "rpn", "top_head", "rcnn"):
+            gmax = max(
+                float(jnp.abs(g).max())
+                for g in jax.tree_util.tree_leaves(grads[sub])
+            )
+            assert gmax > 0, f"no gradient into {sub}"
+
+    def test_overfit_loss_decreases(self, fpn_model_and_params):
+        cfg, model, params = fpn_model_and_params
+        tx = make_optimizer(cfg, lambda s: 0.002)
+        state = create_train_state(params, tx)
+        step = make_train_step(model, tx, donate=False)
+        batch = fpn_batch(np.random.RandomState(3))
+        losses = []
+        for _ in range(20):
+            state, aux = step(state, batch, jax.random.key(42))
+            losses.append(float(aux["loss"]))
+        assert np.isfinite(losses).all()
+        assert np.mean(losses[-3:]) < np.mean(losses[:3]) * 0.8
+
+
+class TestMaskTargets:
+    def test_rasterize_full_and_partial(self):
+        rois = jnp.asarray([[0, 0, 27, 27], [0, 0, 27, 27]], jnp.float32)
+        gts = jnp.asarray([[0, 0, 27, 27], [0, 0, 13, 27]], jnp.float32)
+        m = np.asarray(rasterize_box_masks(rois, gts, 28))
+        assert m.shape == (2, 28, 28)
+        assert m[0].all()                       # gt covers the whole roi
+        assert m[1][:, :14].all() and not m[1][:, 14:].any()  # left half
+
+    def test_disjoint_gt_gives_empty(self):
+        rois = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+        gts = jnp.asarray([[50, 50, 60, 60]], jnp.float32)
+        m = np.asarray(rasterize_box_masks(rois, gts, 14))
+        assert not m.any()
+
+
+class TestMaskRCNN:
+    def test_mask_train_and_inference(self):
+        cfg = fpn_cfg("mask_resnet_fpn")
+        # mask_resnet_fpn registry uses depth 101; shrink for test speed
+        cfg = cfg.replace(
+            network=dataclasses.replace(cfg.network, depth=50)
+        )
+        model = build_model(cfg)
+        batch = fpn_batch(np.random.RandomState(0))
+        params = model.init(
+            {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+            train=True, **batch,
+        )["params"]
+        loss, aux = model.apply(
+            {"params": params}, train=True,
+            rngs={"sampling": jax.random.key(2)}, **batch,
+        )
+        assert "MaskBCELoss" in aux
+        assert np.isfinite(float(aux["MaskBCELoss"]))
+
+        # mask loss decreases on a fixed batch
+        tx = make_optimizer(cfg, lambda s: 0.002)
+        state = create_train_state(params, tx)
+        step = make_train_step(model, tx, donate=False)
+        m_losses = []
+        for _ in range(12):
+            state, aux = step(state, batch, jax.random.key(7))
+            m_losses.append(float(aux["MaskBCELoss"]))
+        assert np.isfinite(m_losses).all()
+        assert np.mean(m_losses[-3:]) < np.mean(m_losses[:3])
+
+        out = model.apply(
+            {"params": state.params}, batch["images"], batch["im_info"],
+            train=False,
+        )
+        r = cfg.TEST.RPN_POST_NMS_TOP_N
+        s = cfg.TRAIN.MASK_SIZE
+        k = cfg.dataset.NUM_CLASSES
+        assert out["mask_logits"].shape == (1, r, s, s, k)

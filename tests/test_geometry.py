@@ -126,3 +126,36 @@ class TestClipBoxes:
         boxes = jnp.array([[-10.0, -5.0, 700.0, 400.0, 5.0, 5.0, 7.0, 8.0]])
         out = clip_boxes(boxes, (300, 500))
         np.testing.assert_allclose(out, [[0, 0, 499, 299, 5, 5, 7, 8]])
+
+
+class TestNumpyTwins:
+    """Host-side numpy helpers must stay golden-consistent with the jnp
+    ops (utils/bbox_stats.py documents this invariant)."""
+
+    def test_np_overlaps_matches_ops(self, rng):
+        from mx_rcnn_tpu.ops.boxes import bbox_overlaps
+        from mx_rcnn_tpu.utils.bbox_stats import np_overlaps
+
+        a = rng.rand(17, 4).astype(np.float32) * 100
+        a[:, 2:] += a[:, :2]
+        b = rng.rand(9, 4).astype(np.float32) * 100
+        b[:, 2:] += b[:, :2]
+        np.testing.assert_allclose(
+            np_overlaps(a, b), np.asarray(bbox_overlaps(a, b)), atol=1e-6
+        )
+
+    def test_np_transform_matches_ops(self, rng):
+        from mx_rcnn_tpu.ops.boxes import bbox_transform
+        from mx_rcnn_tpu.utils.bbox_stats import np_transform
+
+        a = rng.rand(9, 4).astype(np.float32) * 100
+        a[:, 2:] += a[:, :2]
+        b = rng.rand(9, 4).astype(np.float32) * 100
+        b[:, 2:] += b[:, :2]
+        np.testing.assert_allclose(
+            np_transform(a, b), np.asarray(bbox_transform(a, b)), atol=1e-4
+        )
+        # degenerate gt/ex boxes stay finite in both
+        z = np.zeros((2, 4), np.float32)
+        assert np.isfinite(np_transform(z, z)).all()
+        assert np.isfinite(np.asarray(bbox_transform(z, z))).all()

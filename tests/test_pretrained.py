@@ -146,6 +146,34 @@ class TestResNetImport:
             np.asarray(got), expected, rtol=2e-4, atol=2e-4
         )
 
+    def test_fpn_layout_puts_stage4_in_backbone(self, rng):
+        import dataclasses
+
+        from mx_rcnn_tpu.config import generate_config
+        from mx_rcnn_tpu.models import build_model
+
+        sd = fake_resnet_sd(rng, 50)
+        backbone, top_head = import_resnet(sd, 50, fpn=True)
+        assert top_head == {}
+        assert "stage4" in backbone
+        cfg = generate_config("resnet_fpn", "PascalVOC")
+        cfg = cfg.replace(
+            network=dataclasses.replace(cfg.network, depth=50),
+            dataset=dataclasses.replace(cfg.dataset, MAX_GT_BOXES=4),
+        )
+        model = build_model(cfg)
+        params = model.init(
+            {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+            jnp.zeros((1, 64, 64, 3)),
+            jnp.asarray([[64.0, 64.0, 1.0]]),
+            jnp.zeros((1, 4, 5)),
+            jnp.zeros((1, 4), bool),
+            train=True,
+        )["params"]
+        assert tree_shapes(backbone) == tree_shapes(
+            jax.device_get(params["backbone"])
+        )
+
     def test_apply_pretrained_merges_and_preserves_heads(self, rng):
         from mx_rcnn_tpu.config import generate_config
         from mx_rcnn_tpu.models import FasterRCNN
