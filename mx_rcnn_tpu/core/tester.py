@@ -69,7 +69,12 @@ def im_detect(
     # final clip to the original image extent
     h, w = orig_hw
     boxes = np.asarray(clip_boxes(boxes, (float(h), float(w))))
-    return {"scores": scores[valid], "boxes": boxes[valid]}
+    det = {"scores": scores[valid], "boxes": boxes[valid]}
+    if "mask_logits" in output:  # Mask R-CNN branch: per-roi (S, S, K)
+        det["mask_probs"] = 1.0 / (
+            1.0 + np.exp(-np.asarray(output["mask_logits"][0][valid]))
+        )
+    return det
 
 
 def pred_eval(
@@ -98,11 +103,15 @@ def pred_eval(
         [np.zeros((0, 5), np.float32) for _ in range(num_images)]
         for _ in range(num_classes)
     ]
+    all_masks: Optional[List[List[list]]] = None
     t0 = time.time()
     for i, (rec, batch) in enumerate(loader):
         out = predictor.predict(batch)
         det = im_detect(out, batch["im_info"][0], (rec["height"], rec["width"]))
         scores, boxes = det["scores"], det["boxes"]
+        with_masks = "mask_probs" in det
+        if with_masks and all_masks is None:
+            all_masks = [[[] for _ in range(num_images)] for _ in range(num_classes)]
         for j in range(1, num_classes):
             keep = np.where(scores[:, j] > thresh)[0]
             cls_dets = np.hstack(
@@ -110,6 +119,14 @@ def pred_eval(
             ).astype(np.float32)
             keep_nms = nms_numpy(cls_dets, te.NMS)
             all_boxes[j][i] = cls_dets[keep_nms]
+            if with_masks:
+                from mx_rcnn_tpu.eval.segm import mask_to_rle
+
+                probs = det["mask_probs"][keep][keep_nms, :, :, j]
+                all_masks[j][i] = [
+                    mask_to_rle(p, b[:4], rec["height"], rec["width"])
+                    for p, b in zip(probs, all_boxes[j][i])
+                ]
         # cap detections per image across classes (COCO: 100)
         if te.MAX_PER_IMAGE > 0:
             all_scores = np.concatenate(
@@ -120,6 +137,10 @@ def pred_eval(
                 for j in range(1, num_classes):
                     keep = all_boxes[j][i][:, 4] >= cut
                     all_boxes[j][i] = all_boxes[j][i][keep]
+                    if with_masks:
+                        all_masks[j][i] = [
+                            m for m, k in zip(all_masks[j][i], keep) if k
+                        ]
         if vis:
             import os
 
@@ -139,7 +160,20 @@ def pred_eval(
     if dump_path:
         with open(dump_path, "wb") as f:
             pickle.dump(all_boxes, f, pickle.HIGHEST_PROTOCOL)
-    results = imdb.evaluate_detections(all_boxes)
+    if all_masks is not None:
+        import inspect
+
+        sig = inspect.signature(imdb.evaluate_detections)
+        if "all_masks" in sig.parameters:
+            results = imdb.evaluate_detections(all_boxes, all_masks=all_masks)
+        else:  # dataset without segm support: bbox-only
+            logger.warning(
+                "%s.evaluate_detections has no all_masks support — "
+                "dropping segm results", type(imdb).__name__
+            )
+            results = imdb.evaluate_detections(all_boxes)
+    else:
+        results = imdb.evaluate_detections(all_boxes)
     return all_boxes, results
 
 

@@ -85,30 +85,42 @@ class COCO(IMDB):
         )
 
     # -- evaluation -------------------------------------------------------
-    def evaluate_detections(self, detections, save_json: str | None = None):
+    def evaluate_detections(self, detections, save_json: str | None = None,
+                            all_masks=None):
         """detections[cls][img_i] = (n, 5).  Runs the 12-metric COCO bbox
-        protocol; returns the stats dict (mAP@[.5:.95] under 'AP')."""
+        protocol; returns the stats dict (mAP@[.5:.95] under 'AP').
+
+        ``all_masks[cls][img_i]`` = list of image-space RLE dicts parallel
+        to the detections (Mask R-CNN) additionally runs the segm protocol
+        and returns its stats under ``segm_*`` keys.
+        """
         results = []
         for cls_idx in range(1, self.num_classes):
             cat_id = self._class_to_cat_id[cls_idx]
             for i, img_id in enumerate(self.image_set_index):
                 dets = np.asarray(detections[cls_idx][i]).reshape(-1, 5)
-                for x1, y1, x2, y2, score in dets:
-                    results.append(
-                        {
-                            "image_id": int(img_id),
-                            "category_id": int(cat_id),
-                            "bbox": [
-                                float(x1),
-                                float(y1),
-                                float(x2 - x1 + 1),
-                                float(y2 - y1 + 1),
-                            ],
-                            "score": float(score),
-                        }
-                    )
+                for d, (x1, y1, x2, y2, score) in enumerate(dets):
+                    res = {
+                        "image_id": int(img_id),
+                        "category_id": int(cat_id),
+                        "bbox": [
+                            float(x1),
+                            float(y1),
+                            float(x2 - x1 + 1),
+                            float(y2 - y1 + 1),
+                        ],
+                        "score": float(score),
+                    }
+                    if all_masks is not None:
+                        res["segmentation"] = all_masks[cls_idx][i][d]
+                    results.append(res)
         if save_json:
             with open(save_json, "w") as f:
                 json.dump(results, f)
-        evaluator = COCOEvalBbox(self._dataset, results)
-        return evaluator.evaluate()
+        stats = COCOEvalBbox(self._dataset, results).evaluate()
+        if all_masks is not None:
+            segm_stats = COCOEvalBbox(
+                self._dataset, results, iou_type="segm"
+            ).evaluate(verbose=False)
+            stats.update({f"segm_{k}": v for k, v in segm_stats.items()})
+        return stats

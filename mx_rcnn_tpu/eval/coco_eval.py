@@ -50,10 +50,19 @@ def _iou_xywh(dets: np.ndarray, gts: np.ndarray, iscrowd: np.ndarray) -> np.ndar
 
 
 class COCOEvalBbox:
-    def __init__(self, dataset: Dict, results: List[Dict]):
+    def __init__(self, dataset: Dict, results: List[Dict], iou_type: str = "bbox"):
         """``dataset``: the loaded instances json (images/annotations/
         categories); ``results``: list of {image_id, category_id, bbox
-        (xywh), score} detection dicts."""
+        (xywh), score} detection dicts — for ``iou_type='segm'`` each
+        result additionally carries ``segmentation`` (RLE dict) and gt
+        annotations carry polygon or RLE ``segmentation`` (matched with
+        the native RLE library, ``mx_rcnn_tpu/native/rle.py``)."""
+        assert iou_type in ("bbox", "segm")
+        self.iou_type = iou_type
+        self._img_hw = {
+            im["id"]: (im.get("height", 0), im.get("width", 0))
+            for im in dataset["images"]
+        }
         self.img_ids = sorted({im["id"] for im in dataset["images"]})
         self.cat_ids = sorted({c["id"] for c in dataset["categories"]})
         self._gts: Dict = {(i, c): [] for i in self.img_ids for c in self.cat_ids}
@@ -109,7 +118,12 @@ class COCOEvalBbox:
 
         d_boxes = np.array([d["bbox"] for d in dts]).reshape(-1, 4)
         d_scores = np.array([d["score"] for d in dts])
-        ious = _iou_xywh(d_boxes, g_boxes, g_crowd)
+        if self.iou_type == "segm":
+            ious, d_area = self._segm_iou(img_id, cat_id, dts, gts)
+            ious = ious[:, g_order]
+        else:
+            ious = _iou_xywh(d_boxes, g_boxes, g_crowd)
+            d_area = d_boxes[:, 2] * d_boxes[:, 3]
 
         T, D, G = len(IOU_THRS), len(dts), len(gts)
         thr = np.minimum(IOU_THRS, 1 - 1e-10)                       # (T,)
@@ -141,7 +155,6 @@ class COCOEvalBbox:
                 take = matched & ~g_crowd[np.clip(best, 0, G - 1)]
                 avail[take, best[take]] = False
         # unmatched dets outside the area range are ignored
-        d_area = d_boxes[:, 2] * d_boxes[:, 3]
         d_out = (d_area < area_rng[0]) | (d_area > area_rng[1])
         dt_ig |= (dt_m == -1) & d_out[None, :]
         return {
@@ -151,6 +164,38 @@ class COCOEvalBbox:
             "gt_ignore": g_ignore,
             "num_gt": int((~g_ignore).sum()),
         }
+
+    def _gt_rle(self, ann, img_id):
+        """gt segmentation → RLE dict (polygons rasterized via the native
+        library, compressed crowd strings decoded; cached on the ann)."""
+        if "_rle" not in ann:
+            from mx_rcnn_tpu.native import rle as rle_api
+
+            seg = ann["segmentation"]
+            h, w = self._img_hw[img_id]
+            if isinstance(seg, dict):
+                ann["_rle"] = rle_api.ensure_list_counts(seg)
+            else:
+                ann["_rle"] = rle_api.from_polygons(seg, h, w)
+        return ann["_rle"]
+
+    def _segm_iou(self, img_id, cat_id, dts, gts):
+        """(ious (D, G) in ORIGINAL gt order, det mask areas (D,)) —
+        area-range independent, cached per (img, cat) since _match_pair
+        runs once per area range."""
+        if not hasattr(self, "_segm_cache"):
+            self._segm_cache = {}
+        key = (img_id, cat_id)
+        if key not in self._segm_cache:
+            from mx_rcnn_tpu.native import rle as rle_api
+
+            crowd = [int(g.get("iscrowd", 0)) for g in gts]
+            gt_rles = [self._gt_rle(g, img_id) for g in gts]
+            dt_rles = [d["segmentation"] for d in dts]
+            ious = rle_api.iou(dt_rles, gt_rles, crowd)
+            d_area = np.array([rle_api.area(r) for r in dt_rles])
+            self._segm_cache[key] = (ious, d_area)
+        return self._segm_cache[key]
 
     def _pair_evals(self, area_rng_key):
         """Cached per-(img, cat) match results at the max det budget for

@@ -1,0 +1,50 @@
+"""Mask post-processing: paste per-roi mask logits into image-space RLEs.
+
+Reference: the descendant Mask R-CNN eval pipelines over
+``rcnn/pycocotools`` — per detection, the S×S mask probability grid is
+resized to the box extent, thresholded, pasted into the full image, and
+RLE-encoded for segm COCOeval (``eval/coco_eval.py`` with
+``iou_type='segm'``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def paste_mask(
+    mask: np.ndarray, box: np.ndarray, h: int, w: int, thresh: float = 0.5
+) -> np.ndarray:
+    """(S, S) probability grid + [x1, y1, x2, y2] image box → (h, w) u8.
+
+    Bilinear resize to the box's pixel extent (+1 convention), threshold,
+    paste at the clipped location.
+    """
+    import cv2
+
+    x1 = int(np.floor(box[0]))
+    y1 = int(np.floor(box[1]))
+    x2 = int(np.ceil(box[2]))
+    y2 = int(np.ceil(box[3]))
+    bw = max(x2 - x1 + 1, 1)
+    bh = max(y2 - y1 + 1, 1)
+    resized = cv2.resize(mask.astype(np.float32), (bw, bh))
+    binary = (resized >= thresh).astype(np.uint8)
+    out = np.zeros((h, w), np.uint8)
+    ox1, oy1 = max(x1, 0), max(y1, 0)
+    ox2, oy2 = min(x2, w - 1), min(y2, h - 1)
+    if ox2 >= ox1 and oy2 >= oy1:
+        out[oy1 : oy2 + 1, ox1 : ox2 + 1] = binary[
+            oy1 - y1 : oy2 - y1 + 1, ox1 - x1 : ox2 - x1 + 1
+        ]
+    return out
+
+
+def mask_to_rle(mask_prob: np.ndarray, box: np.ndarray, h: int, w: int,
+                thresh: float = 0.5) -> Dict:
+    """Probability grid + box → image-space RLE dict."""
+    from mx_rcnn_tpu.native import rle
+
+    return rle.encode(paste_mask(mask_prob, box, h, w, thresh))

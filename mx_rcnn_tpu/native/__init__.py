@@ -1,0 +1,3 @@
+"""Native (C) runtime components, built on demand with the image's cc
+toolchain and bound via ctypes.  Currently: the RLE mask library
+(``rlelib.c``) replacing the reference's vendored ``maskApi.c``."""

@@ -69,6 +69,9 @@ def parse_args(argv=None):
                    help="stop after N steps (smoke runs)")
     p.add_argument("--cpu", type=int, default=0, metavar="N",
                    help="force the host backend with N virtual devices")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of steps 10-20 into "
+                        "DIR (view with tensorboard/xprof)")
     return p.parse_args(argv)
 
 
@@ -179,9 +182,16 @@ def train_net(args):
         for batch in loader:
             if use_mesh:
                 batch = shard_batch(batch, mesh)
+            # profiler window: skip compile/warmup, capture steady state
+            # (SURVEY §5.2 — the reference had only a Speedometer)
+            if args.profile and total_steps == 10:
+                jax.profiler.start_trace(args.profile)
             state, aux = step_fn(state, batch, rng)
             tracker.update({k: float(v) for k, v in jax.device_get(aux).items()})
             total_steps += 1
+            if args.profile and total_steps == 20:
+                jax.profiler.stop_trace()
+                logger.info("profiler trace written to %s", args.profile)
             speedo(epoch, total_steps, tracker)
             if args.max_steps and total_steps >= args.max_steps:
                 break
@@ -189,6 +199,10 @@ def train_net(args):
         logger.info("Epoch[%d] checkpoint -> %s", epoch, path)
         if args.max_steps and total_steps >= args.max_steps:
             break
+    if args.profile and 10 < total_steps < 20:
+        # run ended inside the capture window — flush what we have
+        jax.profiler.stop_trace()
+        logger.info("profiler trace (short run) written to %s", args.profile)
     return state
 
 

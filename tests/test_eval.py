@@ -154,3 +154,63 @@ class TestCocoEval:
         ]
         stats = COCOEvalBbox(ds, results).evaluate(verbose=False)
         assert stats["AP_small"] == pytest.approx(1.0)
+
+
+class TestCOCOSegmEval:
+    """segm protocol via the native RLE library (iou_type='segm')."""
+
+    def _ds(self):
+        from mx_rcnn_tpu.native import rle
+
+        images = [{"id": 1, "height": 40, "width": 40}]
+        cats = [{"id": 1}]
+        # gt: 20x20 square as a polygon
+        anns = [{
+            "id": 1, "image_id": 1, "category_id": 1,
+            "bbox": [5, 5, 20, 20], "area": 400, "iscrowd": 0,
+            "segmentation": [[5, 5, 25, 5, 25, 25, 5, 25]],
+        }]
+        return {"images": images, "annotations": anns, "categories": cats}
+
+    def test_perfect_mask_ap1(self):
+        from mx_rcnn_tpu.eval.coco_eval import COCOEvalBbox
+        from mx_rcnn_tpu.native import rle
+
+        m = np.zeros((40, 40), np.uint8)
+        m[5:25, 5:25] = 1
+        results = [{
+            "image_id": 1, "category_id": 1, "bbox": [5, 5, 20, 20],
+            "score": 0.9, "segmentation": rle.encode(m),
+        }]
+        stats = COCOEvalBbox(self._ds(), results, iou_type="segm").evaluate(
+            verbose=False
+        )
+        assert stats["AP"] == pytest.approx(1.0)
+
+    def test_half_mask_scores_lower(self):
+        from mx_rcnn_tpu.eval.coco_eval import COCOEvalBbox
+        from mx_rcnn_tpu.native import rle
+
+        half = np.zeros((40, 40), np.uint8)
+        half[5:25, 5:15] = 1  # IoU 0.5 vs the gt square
+        results = [{
+            "image_id": 1, "category_id": 1, "bbox": [5, 5, 20, 20],
+            "score": 0.9, "segmentation": rle.encode(half),
+        }]
+        stats = COCOEvalBbox(self._ds(), results, iou_type="segm").evaluate(
+            verbose=False
+        )
+        # matches at IoU .5 only → AP ≈ 1/10 of thresholds
+        assert 0.05 < stats["AP"] < 0.2
+        assert stats["AP50"] == pytest.approx(1.0)
+
+    def test_paste_mask_roundtrip(self):
+        from mx_rcnn_tpu.eval.segm import mask_to_rle, paste_mask
+        from mx_rcnn_tpu.native import rle
+
+        prob = np.ones((28, 28), np.float32)
+        out = paste_mask(prob, np.array([10, 12, 19, 21]), 40, 40)
+        assert out[12:22, 10:20].all()
+        assert out.sum() == 10 * 10
+        r = mask_to_rle(prob, np.array([10, 12, 19, 21]), 40, 40)
+        np.testing.assert_array_equal(rle.decode(r), out)

@@ -1,0 +1,150 @@
+"""Native RLE mask library vs the numpy fallback and hand goldens
+(reference: rcnn/pycocotools/maskApi.c, SURVEY N5)."""
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.native import rle
+
+
+def random_mask(rng, h, w, p=0.4):
+    return (rng.rand(h, w) < p).astype(np.uint8)
+
+
+class TestRoundtrip:
+    def test_encode_decode_identity(self, rng):
+        for h, w in [(1, 1), (7, 5), (32, 17), (64, 64)]:
+            m = random_mask(rng, h, w)
+            r = rle.encode(m)
+            assert r["size"] == [h, w]
+            np.testing.assert_array_equal(rle.decode(r), m)
+
+    def test_golden_counts_column_major(self):
+        # 2x2: only the top-right pixel set → column-major index 2
+        m = np.array([[0, 1], [0, 0]], np.uint8)
+        r = rle.encode(m)
+        assert r["counts"] == [2, 1, 1]
+
+    def test_all_ones_and_zeros(self):
+        ones = np.ones((4, 3), np.uint8)
+        r = rle.encode(ones)
+        assert r["counts"] == [0, 12]
+        assert rle.area(r) == 12
+        zeros = np.zeros((4, 3), np.uint8)
+        r0 = rle.encode(zeros)
+        assert rle.area(r0) == 0
+        np.testing.assert_array_equal(rle.decode(r0), zeros)
+
+
+class TestAreaIouMerge:
+    def test_area_matches_sum(self, rng):
+        m = random_mask(rng, 20, 30)
+        assert rle.area(rle.encode(m)) == m.sum()
+
+    def test_iou_matches_dense(self, rng):
+        dts = [rle.encode(random_mask(rng, 16, 16)) for _ in range(4)]
+        gts = [rle.encode(random_mask(rng, 16, 16)) for _ in range(3)]
+        got = rle.iou(dts, gts, [0, 0, 0])
+        dm = np.stack([rle.decode(r).reshape(-1) for r in dts]).astype(float)
+        gm = np.stack([rle.decode(r).reshape(-1) for r in gts]).astype(float)
+        inter = dm @ gm.T
+        union = dm.sum(1)[:, None] + gm.sum(1)[None, :] - inter
+        np.testing.assert_allclose(got, inter / union, atol=1e-9)
+
+    def test_crowd_iou_uses_det_area(self, rng):
+        big = np.ones((10, 10), np.uint8)
+        small = np.zeros((10, 10), np.uint8)
+        small[:5, :5] = 1
+        got = rle.iou([rle.encode(small)], [rle.encode(big)], [1])
+        assert got[0, 0] == pytest.approx(1.0)  # fully inside the crowd
+        got = rle.iou([rle.encode(small)], [rle.encode(big)], [0])
+        assert got[0, 0] == pytest.approx(0.25)
+
+    def test_merge_is_union(self, rng):
+        ms = [random_mask(rng, 12, 9) for _ in range(3)]
+        merged = rle.merge([rle.encode(m) for m in ms])
+        expect = np.zeros((12, 9), np.uint8)
+        for m in ms:
+            expect |= m
+        np.testing.assert_array_equal(rle.decode(merged), expect)
+
+
+class TestPolygons:
+    def test_axis_aligned_square(self):
+        # square covering pixel centers (2..5, 1..3)
+        r = rle.from_polygons([[2, 1, 6, 1, 6, 4, 2, 4]], 8, 10)
+        m = rle.decode(r)
+        expect = np.zeros((8, 10), np.uint8)
+        expect[1:4, 2:6] = 1
+        np.testing.assert_array_equal(m, expect)
+
+    def test_triangle_monotone_area(self):
+        r = rle.from_polygons([[0, 0, 20, 0, 0, 20]], 20, 20)
+        a = rle.area(r)
+        assert 150 < a < 250  # half of 400, rasterization slack
+
+
+class TestNativeVsFallback:
+    def test_paths_agree(self, rng, monkeypatch):
+        """Force the fallback and compare against the native results."""
+        import mx_rcnn_tpu.native.rle as R
+
+        if R._lib() is None:
+            pytest.skip("no native lib on this machine — fallback already used")
+        m = random_mask(rng, 24, 18)
+        native_enc = R.encode(m)
+        native_iou = R.iou([native_enc], [native_enc], [0])
+        poly = [[2.0, 1.0, 15.0, 1.0, 15.0, 20.0, 2.0, 20.0]]
+        native_poly = R.from_polygons(poly, 24, 18)
+
+        monkeypatch.setattr(R, "_LIB", None)
+        monkeypatch.setattr(R, "_TRIED", True)
+        assert R.encode(m) == native_enc
+        np.testing.assert_allclose(R.iou([native_enc], [native_enc], [0]), native_iou)
+        assert R.from_polygons(poly, 24, 18) == native_poly
+
+
+class TestCompressedCounts:
+    """COCO compressed-RLE counts string (crowd gt annotations)."""
+
+    @staticmethod
+    def _to_string(counts):
+        """Test-side encoder mirroring pycocotools rleToString."""
+        s = []
+        for m, c in enumerate(counts):
+            x = int(c)
+            if m > 2:
+                x -= int(counts[m - 2])
+            more = True
+            while more:
+                chunk = x & 0x1F
+                x >>= 5
+                more = not (x == 0 and not (chunk & 0x10)) and not (
+                    x == -1 and (chunk & 0x10)
+                )
+                if more:
+                    chunk |= 0x20
+                s.append(chr(48 + chunk))
+        return "".join(s)
+
+    def test_simple_golden(self):
+        # delta coding starts at the 4th element (pycocotools i>2):
+        # "2322" → [2, 3, 2, 2+counts[1]] = [2, 3, 2, 5]
+        assert rle.counts_from_string("232") == [2, 3, 2]
+        assert rle.counts_from_string("2322") == [2, 3, 2, 5]
+
+    def test_roundtrip_random(self, rng):
+        for _ in range(5):
+            m = (rng.rand(13, 17) < 0.5).astype(np.uint8)
+            counts = rle.encode(m)["counts"]
+            s = self._to_string(counts)
+            assert rle.counts_from_string(s) == counts
+
+    def test_ensure_list_counts(self, rng):
+        m = (rng.rand(9, 9) < 0.5).astype(np.uint8)
+        r = rle.encode(m)
+        compressed = {"size": r["size"], "counts": self._to_string(r["counts"])}
+        back = rle.ensure_list_counts(compressed)
+        assert back == r
+        # already-list dicts pass through untouched
+        assert rle.ensure_list_counts(r) == r

@@ -1,0 +1,98 @@
+"""VGG-16 Faster R-CNN path (BASELINE config 1): fwd/bwd, roi_pool mode,
+overfit — VERDICT r1 weak #4 ("VGG path is write-only code")."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.train import (
+    create_train_state,
+    is_frozen_path,
+    make_optimizer,
+    make_train_step,
+)
+from mx_rcnn_tpu.models import build_model
+from tests.test_model import tiny_batch
+
+
+def vgg_cfg():
+    cfg = generate_config("vgg", "PascalVOC")
+    assert cfg.network.ROI_MODE == "roi_pool"       # MXNet-compat mode
+    assert cfg.network.POOLED_SIZE == (7, 7)
+    return cfg.replace(
+        dataset=dataclasses.replace(cfg.dataset, NUM_CLASSES=4),
+        TRAIN=dataclasses.replace(
+            cfg.TRAIN,
+            RPN_PRE_NMS_TOP_N=400,
+            RPN_POST_NMS_TOP_N=64,
+            BATCH_ROIS=32,
+            RPN_BATCH_SIZE=64,
+        ),
+        TEST=dataclasses.replace(
+            cfg.TEST, RPN_PRE_NMS_TOP_N=200, RPN_POST_NMS_TOP_N=32
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def vgg_model_and_params():
+    cfg = vgg_cfg()
+    model = build_model(cfg)
+    # 192: smallest anchor (128 px) must fit inside the border
+    batch = tiny_batch(np.random.RandomState(0), h=192, w=192)
+    params = model.init(
+        {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+        train=True, **batch,
+    )["params"]
+    return cfg, model, params
+
+
+class TestVGGFasterRCNN:
+    def test_train_forward_and_frozen_blocks(self, vgg_model_and_params):
+        cfg, model, params = vgg_model_and_params
+        batch = tiny_batch(np.random.RandomState(1), h=192, w=192)
+        loss, aux = model.apply(
+            {"params": params}, train=True,
+            rngs={"sampling": jax.random.key(2)}, **batch,
+        )
+        assert np.isfinite(float(loss)) and float(loss) > 0
+        assert float(aux["num_fg_anchors"]) > 0
+        # conv1/conv2 frozen (reference FIXED_PARAMS for vgg)
+        assert is_frozen_path(
+            ("backbone", "conv1_1", "kernel"), cfg.network.FIXED_PARAMS
+        )
+        assert is_frozen_path(
+            ("backbone", "conv2_2", "bias"), cfg.network.FIXED_PARAMS
+        )
+        assert not is_frozen_path(
+            ("backbone", "conv3_1", "kernel"), cfg.network.FIXED_PARAMS
+        )
+
+    def test_test_forward_shapes(self, vgg_model_and_params):
+        cfg, model, params = vgg_model_and_params
+        batch = tiny_batch(np.random.RandomState(1), h=192, w=192)
+        out = model.apply(
+            {"params": params}, batch["images"], batch["im_info"], train=False
+        )
+        r = cfg.TEST.RPN_POST_NMS_TOP_N
+        k = cfg.dataset.NUM_CLASSES
+        assert out["cls_prob"].shape == (1, r, k)
+        assert out["bbox_deltas"].shape == (1, r, 4 * k)
+        assert out["roi_valid"].sum() > 0
+
+    def test_overfit_loss_decreases(self, vgg_model_and_params):
+        cfg, model, params = vgg_model_and_params
+        tx = make_optimizer(cfg, lambda s: 0.001)
+        state = create_train_state(params, tx)
+        step = make_train_step(model, tx, donate=False)
+        batch = tiny_batch(np.random.RandomState(3), h=192, w=192)
+        losses = []
+        for _ in range(20):
+            state, aux = step(state, batch, jax.random.key(42))
+            losses.append(float(aux["loss"]))
+        assert np.isfinite(losses).all()
+        assert np.mean(losses[-3:]) < np.mean(losses[:3]) * 0.9
