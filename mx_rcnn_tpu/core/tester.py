@@ -112,6 +112,7 @@ def pred_eval(
         with_masks = "mask_probs" in det
         if with_masks and all_masks is None:
             all_masks = [[[] for _ in range(num_images)] for _ in range(num_classes)]
+        mask_probs: Dict[int, np.ndarray] = {}
         for j in range(1, num_classes):
             keep = np.where(scores[:, j] > thresh)[0]
             cls_dets = np.hstack(
@@ -120,13 +121,7 @@ def pred_eval(
             keep_nms = nms_numpy(cls_dets, te.NMS)
             all_boxes[j][i] = cls_dets[keep_nms]
             if with_masks:
-                from mx_rcnn_tpu.eval.segm import mask_to_rle
-
-                probs = det["mask_probs"][keep][keep_nms, :, :, j]
-                all_masks[j][i] = [
-                    mask_to_rle(p, b[:4], rec["height"], rec["width"])
-                    for p, b in zip(probs, all_boxes[j][i])
-                ]
+                mask_probs[j] = det["mask_probs"][keep][keep_nms, :, :, j]
         # cap detections per image across classes (COCO: 100)
         if te.MAX_PER_IMAGE > 0:
             all_scores = np.concatenate(
@@ -138,9 +133,17 @@ def pred_eval(
                     keep = all_boxes[j][i][:, 4] >= cut
                     all_boxes[j][i] = all_boxes[j][i][keep]
                     if with_masks:
-                        all_masks[j][i] = [
-                            m for m, k in zip(all_masks[j][i], keep) if k
-                        ]
+                        mask_probs[j] = mask_probs[j][keep]
+        if with_masks:
+            # paste/encode only the survivors — full-image mask work for
+            # detections the cap then discards dominated segm eval cost
+            from mx_rcnn_tpu.eval.segm import mask_to_rle
+
+            for j in range(1, num_classes):
+                all_masks[j][i] = [
+                    mask_to_rle(p, b[:4], rec["height"], rec["width"])
+                    for p, b in zip(mask_probs[j], all_boxes[j][i])
+                ]
         if vis:
             import os
 

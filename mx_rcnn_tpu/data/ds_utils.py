@@ -1,0 +1,33 @@
+"""Small roidb box utilities.
+
+Reference: ``rcnn/dataset/ds_utils.py`` — ``unique_boxes`` (hash-dedup)
+and ``filter_small_boxes``, used by the selective-search legacy paths and
+proposal post-processing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def unique_boxes(boxes: np.ndarray, scale: float = 1.0) -> np.ndarray:
+    """Indices of unique boxes (first occurrence kept, original order).
+
+    Reference hashes ``round(box * scale)`` with a dot-product; numpy's
+    structured unique on the rounded coords is collision-free and
+    order-preserving via the returned first indices.
+    """
+    if len(boxes) == 0:
+        return np.zeros((0,), np.int64)
+    v = np.round(np.asarray(boxes, np.float64) * scale).astype(np.int64)
+    _, index = np.unique(v, axis=0, return_index=True)
+    return np.sort(index)
+
+
+def filter_small_boxes(boxes: np.ndarray, min_size: float) -> np.ndarray:
+    """Indices of boxes with both sides ≥ min_size (+1 convention)."""
+    if len(boxes) == 0:
+        return np.zeros((0,), np.int64)
+    w = boxes[:, 2] - boxes[:, 0] + 1
+    h = boxes[:, 3] - boxes[:, 1] + 1
+    return np.where((w >= min_size) & (h >= min_size))[0]

@@ -16,6 +16,20 @@ import numpy as np
 from mx_rcnn_tpu.data.imdb import IMDB
 
 
+def class_color(cls: int) -> np.ndarray:
+    """Saturated, well-separated class color: every class must be clearly
+    distinguishable from the 90-150 gray noise background AND from every
+    other class, or overfit gates hit an invisible-object mAP ceiling.
+    Golden-ratio hue spacing keeps arbitrary class counts distinct."""
+    hue = ((cls - 1) * 0.61803398875) % 1.0
+    i = int(hue * 6.0)
+    f = hue * 6.0 - i
+    v, s = 235.0, 0.85
+    p, q, t = v * (1 - s), v * (1 - s * f), v * (1 - s * (1 - f))
+    rgb = [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v), (v, p, q)][i % 6]
+    return np.asarray(rgb, np.float32)
+
+
 def synthetic_image(rec: Dict, seed: int) -> np.ndarray:
     """Render the record: noise background + filled class-colored boxes."""
     rng = np.random.RandomState(seed)
@@ -23,10 +37,7 @@ def synthetic_image(rec: Dict, seed: int) -> np.ndarray:
     im = rng.rand(h, w, 3).astype(np.float32) * 60.0 + 90.0
     for box, cls in zip(rec["boxes"], rec["gt_classes"]):
         x1, y1, x2, y2 = box.astype(int)
-        color = np.array(
-            [50 + 40 * (cls % 5), 60 + 30 * (cls % 7), 70 + 25 * (cls % 3)],
-            np.float32,
-        )
+        color = class_color(int(cls))
         im[y1 : y2 + 1, x1 : x2 + 1] = color + rng.rand(
             y2 - y1 + 1, x2 - x1 + 1, 3
         ).astype(np.float32) * 10.0

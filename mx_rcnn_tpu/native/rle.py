@@ -31,17 +31,30 @@ _TRIED = False
 
 
 def _build_and_load() -> Optional[ctypes.CDLL]:
-    """Compile rlelib.c → a per-machine .so (cached) and dlopen it."""
-    so_path = os.path.join(tempfile.gettempdir(), "mx_rcnn_tpu_rlelib.so")
+    """Compile rlelib.c → a per-user cached .so and dlopen it.
+
+    The cache lives under a 0700 per-user directory (never a shared
+    world-writable path another user could pre-seed), and the build
+    writes to a unique temp name + atomic rename so concurrent processes
+    never dlopen a half-written file."""
+    cache_dir = os.environ.get(
+        "XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")
+    )
+    cache_dir = os.path.join(cache_dir, "mx_rcnn_tpu")
+    so_path = os.path.join(cache_dir, "rlelib.so")
     try:
         if (not os.path.exists(so_path)) or (
             os.path.getmtime(so_path) < os.path.getmtime(_SRC)
         ):
+            os.makedirs(cache_dir, mode=0o700, exist_ok=True)
             cc = os.environ.get("CC", "cc")
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
+            os.close(fd)
             subprocess.run(
-                [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", so_path],
+                [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
                 check=True, capture_output=True,
             )
+            os.replace(tmp, so_path)
         lib = ctypes.CDLL(so_path)
     except Exception as e:  # no compiler / load failure → numpy fallback
         logger.warning("native rlelib unavailable (%s); using numpy fallback", e)
@@ -236,7 +249,8 @@ def _poly_fill_np(pts: np.ndarray, h: int, w: int) -> np.ndarray:
                 ys.append(y0 + t * (y1 - y0))
         ys.sort()
         for a, b in zip(ys[0::2], ys[1::2]):
-            r0 = int(np.ceil(a - 0.5))
-            r1 = int(np.floor(b - 0.5))
-            m[max(r0, 0): min(r1, h - 1) + 1, col] = 1
+            r0 = max(int(np.ceil(a - 0.5)), 0)
+            r1 = min(int(np.floor(b - 0.5)), h - 1)
+            if r1 >= r0:  # crossings fully off-image must fill nothing
+                m[r0 : r1 + 1, col] = 1
     return m

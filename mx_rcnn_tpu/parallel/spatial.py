@@ -1,0 +1,54 @@
+"""Spatial (context) parallelism: shard the image plane across chips.
+
+SURVEY §5.7: the reference's analog of sequence-length scaling is input
+resolution, which it handled per-GPU only.  Here very large inputs can be
+sharded along H over the mesh's ``model`` axis: convolutions under jit
+with a spatial input sharding make XLA insert the halo exchanges
+(collective-permutes of the kernel-overlap rows) automatically — the
+image-domain equivalent of ring/all-to-all sequence parallelism, with
+the compiler as the communication backend (no hand-written NCCL ring).
+
+Usage::
+
+    mesh = make_mesh(n_data=2, n_model=4)
+    fn = spatial_sharded_backbone(backbone.apply, mesh)
+    feat = fn(params, images)        # images sharded (data, model) on (B, H)
+
+The backbone is closed over by jit with explicit in/out shardings; the
+output feature map comes back sharded the same way, ready for sharded
+RPN heads or a gather before roi pooling.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def spatial_shardings(mesh: Mesh):
+    """(image_sharding, replicated) — batch on 'data', H on 'model'."""
+    return (
+        NamedSharding(mesh, P("data", "model", None, None)),
+        NamedSharding(mesh, P()),
+    )
+
+
+def spatial_sharded_backbone(apply_fn, mesh: Mesh):
+    """jit ``apply_fn(params, images)`` with (B, H) sharded in/out.
+
+    XLA partitions every conv spatially and inserts halo exchanges on the
+    ``model`` axis for the kernel overlaps; params stay replicated.
+    """
+    img_sharding, rep = spatial_shardings(mesh)
+
+    return jax.jit(
+        apply_fn,
+        in_shardings=(rep, img_sharding),
+        out_shardings=img_sharding,
+    )
+
+
+def shard_images_spatial(images, mesh: Mesh):
+    """Place (B, H, W, C) images with B on 'data' and H on 'model'."""
+    img_sharding, _ = spatial_shardings(mesh)
+    return jax.device_put(images, img_sharding)

@@ -87,3 +87,29 @@ class TestBucketGuard:
     def test_oversize_image_raises(self):
         with pytest.raises(ValueError):
             pad_to_bucket(np.zeros((200, 100, 3), np.float32), (128, 128))
+
+
+class TestDsUtils:
+    def test_unique_boxes(self):
+        from mx_rcnn_tpu.data.ds_utils import unique_boxes
+
+        boxes = np.array(
+            [[1, 2, 3, 4], [1, 2, 3, 4], [5, 6, 7, 8], [1, 2, 3, 4.2]],
+            np.float32,
+        )
+        keep = unique_boxes(boxes)
+        # 4.2 rounds to 4 → duplicate of row 0 at scale 1
+        np.testing.assert_array_equal(keep, [0, 2])
+        keep16 = unique_boxes(boxes, scale=16.0)
+        np.testing.assert_array_equal(keep16, [0, 2, 3])
+
+    def test_filter_small_boxes(self):
+        from mx_rcnn_tpu.data.ds_utils import filter_small_boxes
+
+        boxes = np.array(
+            [[0, 0, 9, 9], [0, 0, 3, 9], [0, 0, 9, 3]], np.float32
+        )
+        np.testing.assert_array_equal(filter_small_boxes(boxes, 5), [0])
+        np.testing.assert_array_equal(
+            filter_small_boxes(boxes, 4), [0, 1, 2]
+        )

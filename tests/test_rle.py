@@ -148,3 +148,20 @@ class TestCompressedCounts:
         assert back == r
         # already-list dicts pass through untouched
         assert rle.ensure_list_counts(r) == r
+
+
+class TestOffImagePolygons:
+    def test_fully_above_image_fills_nothing(self):
+        r = rle.from_polygons([[0, -5, 8, -5, 8, -3, 0, -3]], 10, 12)
+        assert rle.area(r) == 0
+
+    def test_fallback_matches_native_off_image(self, rng, monkeypatch):
+        import mx_rcnn_tpu.native.rle as R
+
+        if R._lib() is None:
+            pytest.skip("no native lib")
+        poly = [[-3.0, -5.0, 8.0, -5.0, 8.0, 4.0, -3.0, 4.0]]
+        native = R.from_polygons(poly, 10, 12)
+        monkeypatch.setattr(R, "_LIB", None)
+        monkeypatch.setattr(R, "_TRIED", True)
+        assert R.from_polygons(poly, 10, 12) == native
