@@ -6,6 +6,12 @@ its own jitted function on the current default backend.  This is the
 SURVEY §5.2 profiling upgrade: the reference had only a Speedometer.
 
 Usage: python -m mx_rcnn_tpu.tools.profile_step [--dtype bfloat16]
+
+Caveat on relay-attached TPUs (axon): per-dispatch tunnel latency
+(~20-80ms) dominates unchained timings of cheap components — only the
+``full_train_step`` row (state-chained) and on-host backends give honest
+numbers there; for true per-op device time use ``--profile`` on the
+trainer and inspect the xprof trace instead.
 """
 
 from __future__ import annotations
