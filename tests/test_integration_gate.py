@@ -13,6 +13,8 @@ from mx_rcnn_tpu.tools.integration_gate import run_gate
 
 
 def test_overfit_reaches_high_map():
-    out = run_gate(num_images=8, steps=400, eval_every=100, target=0.8)
+    # 500-step budget, lr decays 10x at 250, early-stops at the target
+    # (measured trajectory: ~0.42@100, ~0.72@200, ~0.92@300)
+    out = run_gate(num_images=8, steps=500, eval_every=100, target=0.8)
     assert np.isfinite(out["mAP"])
     assert out["mAP"] >= 0.8, f"integration gate failed: {out}"
