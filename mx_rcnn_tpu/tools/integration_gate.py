@@ -23,6 +23,7 @@ import sys
 
 import jax
 import numpy as np
+import optax
 
 from mx_rcnn_tpu.config import generate_config
 from mx_rcnn_tpu.core.tester import Predictor, pred_eval
@@ -116,8 +117,6 @@ def run_gate(
     )["params"]
     # 10x decay halfway: the constant-lr run overfits noisily (mAP
     # oscillates 0.4-0.7); the decayed tail lets it polish to convergence
-    import optax
-
     tx = make_optimizer(
         cfg, optax.piecewise_constant_schedule(lr, {steps // 2: 0.1})
     )

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Mask R-CNN ResNet-101-FPN on COCO (BASELINE config 5)
+set -euo pipefail
+python -m mx_rcnn_tpu.tools.train_end2end \
+    --network mask_resnet_fpn --dataset coco \
+    --pretrained "${PRETRAINED:-resnet101.pth}" \
+    --compute_dtype bfloat16 --batch_images 2 \
+    --epochs 8 --prefix model/mask_fpn_coco "$@"
+python -m mx_rcnn_tpu.tools.test --network mask_resnet_fpn --dataset coco \
+    --prefix model/mask_fpn_coco

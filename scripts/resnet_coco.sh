@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# ResNet-101 Faster R-CNN on COCO, e2e DP over all chips
+# (reference: script/resnet_coco.sh; lr scales with the global batch)
+set -euo pipefail
+python -m mx_rcnn_tpu.tools.train_end2end \
+    --network resnet --dataset coco \
+    --pretrained "${PRETRAINED:-resnet101.pth}" \
+    --compute_dtype bfloat16 --batch_images 8 \
+    --epochs 8 --prefix model/resnet_coco "$@"
+python -m mx_rcnn_tpu.tools.test --network resnet --dataset coco \
+    --prefix model/resnet_coco

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# ResNet-101 Faster R-CNN on VOC07+12, e2e (reference: script/resnet_voc07.sh)
+set -euo pipefail
+python -m mx_rcnn_tpu.tools.train_end2end \
+    --network resnet --dataset PascalVOC0712 \
+    --pretrained "${PRETRAINED:-resnet101.pth}" \
+    --compute_dtype bfloat16 \
+    --epochs 10 --prefix model/resnet_voc0712 "$@"
+python -m mx_rcnn_tpu.tools.test --network resnet --dataset PascalVOC0712 \
+    --prefix model/resnet_voc0712

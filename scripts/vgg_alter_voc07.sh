@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# VGG-16 4-stage alternate training on VOC07 (reference: script/vgg_alter_voc07.sh)
+set -euo pipefail
+python -m mx_rcnn_tpu.tools.train_alternate \
+    --network vgg --dataset PascalVOC \
+    --pretrained "${PRETRAINED:-vgg16.pth}" \
+    --out_dir model/vgg_alter_voc07 "$@"
+python -m mx_rcnn_tpu.tools.test --network vgg --dataset PascalVOC \
+    --params model/vgg_alter_voc07/final.pkl

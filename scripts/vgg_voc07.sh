@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# VGG-16 Faster R-CNN on VOC07 trainval, e2e (reference: script/vgg_voc07.sh)
+set -euo pipefail
+python -m mx_rcnn_tpu.tools.train_end2end \
+    --network vgg --dataset PascalVOC \
+    --pretrained "${PRETRAINED:-vgg16.pth}" \
+    --epochs 10 --prefix model/vgg_voc07 "$@"
+python -m mx_rcnn_tpu.tools.test --network vgg --dataset PascalVOC \
+    --prefix model/vgg_voc07

@@ -1,0 +1,153 @@
+"""On-disk dataset parsing: VOC XML devkit layout and COCO instances
+JSON, exercised against tiny generated fixture trees (no real datasets in
+this image — the file-path code was otherwise write-only)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+VOC_XML = """<annotation>
+  <size><width>{w}</width><height>{h}</height><depth>3</depth></size>
+  {objects}
+</annotation>"""
+
+VOC_OBJ = """<object>
+  <name>{name}</name>
+  <difficult>{difficult}</difficult>
+  <bndbox><xmin>{x1}</xmin><ymin>{y1}</ymin><xmax>{x2}</xmax><ymax>{y2}</ymax></bndbox>
+</object>"""
+
+
+@pytest.fixture
+def voc_devkit(tmp_path):
+    root = tmp_path / "VOCdevkit"
+    base = root / "VOC2007"
+    (base / "ImageSets" / "Main").mkdir(parents=True)
+    (base / "Annotations").mkdir()
+    (base / "JPEGImages").mkdir()
+    (base / "ImageSets" / "Main" / "trainval.txt").write_text(
+        "000001\n000002\n"
+    )
+    objs1 = VOC_OBJ.format(name="dog", difficult=0, x1=10, y1=20, x2=110, y2=120) + \
+        VOC_OBJ.format(name="cat", difficult=1, x1=1, y1=1, x2=30, y2=30)
+    (base / "Annotations" / "000001.xml").write_text(
+        VOC_XML.format(w=300, h=200, objects=objs1)
+    )
+    objs2 = VOC_OBJ.format(name="person", difficult=0, x1=50, y1=60, x2=150, y2=160)
+    (base / "Annotations" / "000002.xml").write_text(
+        VOC_XML.format(w=320, h=240, objects=objs2)
+    )
+    return str(root)
+
+
+class TestPascalVOCParsing:
+    def test_gt_roidb_from_xml(self, voc_devkit, tmp_path):
+        from mx_rcnn_tpu.data.pascal_voc import PascalVOC
+
+        imdb = PascalVOC("2007_trainval", str(tmp_path / "cache_root"), voc_devkit)
+        roidb = imdb.gt_roidb()
+        assert len(roidb) == 2
+        r = roidb[0]
+        assert (r["height"], r["width"]) == (200, 300)
+        # difficult cat dropped from training gt; 1-index corrected
+        assert len(r["boxes"]) == 1
+        np.testing.assert_allclose(r["boxes"][0], [9, 19, 109, 119])
+        assert imdb.classes[r["gt_classes"][0]] == "dog"
+        assert r["image"].endswith("JPEGImages/000001.jpg")
+
+    def test_eval_with_difficult_semantics(self, voc_devkit, tmp_path):
+        from mx_rcnn_tpu.data.pascal_voc import PascalVOC
+
+        imdb = PascalVOC("2007_trainval", str(tmp_path / "cache_root"), voc_devkit)
+        n_cls = len(imdb.classes)
+        all_boxes = [
+            [np.zeros((0, 5), np.float32) for _ in range(2)]
+            for _ in range(n_cls)
+        ]
+        dog, person, cat = (
+            imdb.classes.index("dog"),
+            imdb.classes.index("person"),
+            imdb.classes.index("cat"),
+        )
+        all_boxes[dog][0] = np.array([[9, 19, 109, 119, 0.9]], np.float32)
+        all_boxes[person][1] = np.array([[50, 60, 150, 160, 0.8]], np.float32)
+        # a detection on the DIFFICULT cat must not count as FP (nor TP)
+        all_boxes[cat][0] = np.array([[0, 0, 29, 29, 0.7]], np.float32)
+        results = imdb.evaluate_detections(all_boxes)
+        assert results["dog"] == pytest.approx(1.0)
+        assert results["person"] == pytest.approx(1.0)
+
+    def test_roidb_cache_roundtrip(self, voc_devkit, tmp_path):
+        from mx_rcnn_tpu.data.pascal_voc import PascalVOC
+
+        cache_root = str(tmp_path / "cache_root")
+        imdb = PascalVOC("2007_trainval", cache_root, voc_devkit)
+        a = imdb.gt_roidb()
+        imdb2 = PascalVOC("2007_trainval", cache_root, voc_devkit)
+        b = imdb2.gt_roidb()  # second load comes from the pickle cache
+        np.testing.assert_array_equal(a[0]["boxes"], b[0]["boxes"])
+
+
+@pytest.fixture
+def coco_tree(tmp_path):
+    root = tmp_path / "coco"
+    (root / "annotations").mkdir(parents=True)
+    (root / "val2017").mkdir()
+    ds = {
+        "images": [
+            {"id": 7, "file_name": "000007.jpg", "height": 100, "width": 150},
+            {"id": 9, "file_name": "000009.jpg", "height": 120, "width": 160},
+        ],
+        "categories": [
+            {"id": 1, "name": "person"},
+            {"id": 3, "name": "car"},
+        ],
+        "annotations": [
+            {"id": 1, "image_id": 7, "category_id": 1,
+             "bbox": [10, 20, 50, 40], "area": 2000, "iscrowd": 0},
+            {"id": 2, "image_id": 7, "category_id": 3,
+             "bbox": [60, 10, 30, 30], "area": 900, "iscrowd": 1},
+            {"id": 3, "image_id": 9, "category_id": 3,
+             "bbox": [5, 5, 80, 60], "area": 4800, "iscrowd": 0},
+        ],
+    }
+    with open(root / "annotations" / "instances_val2017.json", "w") as f:
+        json.dump(ds, f)
+    return str(root)
+
+
+class TestCOCOParsing:
+    def test_gt_roidb_from_json(self, coco_tree, tmp_path):
+        from mx_rcnn_tpu.data.coco import COCO
+
+        imdb = COCO("val2017", str(tmp_path / "cache_root"), coco_tree)
+        roidb = imdb.gt_roidb()
+        assert len(roidb) == 2
+        r7 = roidb[0]
+        assert (r7["height"], r7["width"]) == (100, 150)
+        # crowd annotation excluded from training gt
+        assert len(r7["boxes"]) == 1
+        # xywh → xyxy
+        np.testing.assert_allclose(r7["boxes"][0], [10, 20, 59, 59], atol=1.01)
+        assert r7["image"].endswith("000007.jpg")
+
+    def test_bbox_eval_via_protocol(self, coco_tree, tmp_path):
+        from mx_rcnn_tpu.data.coco import COCO
+
+        imdb = COCO("val2017", str(tmp_path / "cache_root"), coco_tree)
+        roidb = imdb.gt_roidb()
+        n_cls = imdb.num_classes
+        all_boxes = [
+            [np.zeros((0, 5), np.float32) for _ in range(2)]
+            for _ in range(n_cls)
+        ]
+        # perfect detections of the two non-crowd gts
+        for i, rec in enumerate(roidb):
+            for box, cls in zip(rec["boxes"], rec["gt_classes"]):
+                det = np.concatenate([box, [0.95]]).astype(np.float32)
+                all_boxes[int(cls)][i] = np.vstack([all_boxes[int(cls)][i], det])
+        stats = imdb.evaluate_detections(all_boxes)
+        assert stats["AP"] == pytest.approx(1.0)
+        assert stats["AP50"] == pytest.approx(1.0)
