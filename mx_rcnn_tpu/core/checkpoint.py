@@ -1,4 +1,4 @@
-"""Checkpoint save/restore via Orbax.
+"""Checkpoint save/restore via Orbax, plus preemption handling.
 
 Reference: ``rcnn/core/callback.py :: do_checkpoint`` +
 ``rcnn/utils/{save_model,load_model}.py`` — MXNet json+params pairs with
@@ -6,12 +6,21 @@ the bbox-weight de-normalization quirk (SURVEY §5.5).  Here: raw pytree
 state (params + optimizer + step) via Orbax, normalization never folded
 into weights, and resume restores momentum too (the reference restarted
 momentum cold — a known wart we fix).
+
+Failure recovery (SURVEY §5.4 — the reference had none: a GPU failure
+killed the run, restart was manual from the last *epoch*): a
+:class:`PreemptionGuard` turns SIGTERM/SIGINT into a mid-epoch
+checkpoint (``step_EEEE_SSSSSS``) that resume continues from exactly —
+the loader's deterministic epoch plan makes skip-to-batch sound, so a
+preempted-and-resumed run consumes the identical data stream as an
+uninterrupted one.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+import signal
+from typing import Any, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
@@ -19,18 +28,47 @@ import orbax.checkpoint as ocp
 from mx_rcnn_tpu.core.train import TrainState
 
 
-def save_checkpoint(prefix: str, state: TrainState, epoch: int) -> str:
-    """Save to ``{prefix}/epoch_{epoch:04d}`` (one dir per epoch, like the
-    reference's ``prefix-%04d.params`` naming)."""
-    path = os.path.abspath(os.path.join(prefix, f"epoch_{epoch:04d}"))
+def _ckpt_name(epoch: int, batch_in_epoch: int) -> str:
+    """epoch boundary → ``epoch_EEEE`` (the reference's
+    ``prefix-%04d.params`` role); mid-epoch (preemption) →
+    ``step_EEEE_BBBBBB``."""
+    if batch_in_epoch == 0:
+        return f"epoch_{epoch:04d}"
+    return f"step_{epoch:04d}_{batch_in_epoch:06d}"
+
+
+def _parse_ckpt_name(name: str) -> Optional[Tuple[int, int]]:
+    parts = name.split("_")
+    if name.startswith("epoch_") and len(parts) == 2 and parts[1].isdigit():
+        return int(parts[1]), 0
+    if (
+        name.startswith("step_")
+        and len(parts) == 3
+        and parts[1].isdigit()
+        and parts[2].isdigit()
+    ):
+        return int(parts[1]), int(parts[2])
+    return None
+
+
+def save_checkpoint(
+    prefix: str, state: TrainState, epoch: int, batch_in_epoch: int = 0
+) -> str:
+    path = os.path.abspath(
+        os.path.join(prefix, _ckpt_name(epoch, batch_in_epoch))
+    )
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, jax.device_get(state), force=True)
     ckptr.wait_until_finished()
     return path
 
 
-def load_checkpoint(prefix: str, epoch: int, target: TrainState) -> TrainState:
-    path = os.path.abspath(os.path.join(prefix, f"epoch_{epoch:04d}"))
+def load_checkpoint(
+    prefix: str, epoch: int, target: TrainState, batch_in_epoch: int = 0
+) -> TrainState:
+    path = os.path.abspath(
+        os.path.join(prefix, _ckpt_name(epoch, batch_in_epoch))
+    )
     ckptr = ocp.StandardCheckpointer()
     return ckptr.restore(path, target=jax.device_get(target))
 
@@ -44,3 +82,74 @@ def latest_epoch(prefix: str) -> Optional[int]:
         if d.startswith("epoch_") and d.split("_")[1].isdigit()
     ]
     return max(epochs) if epochs else None
+
+
+def latest_checkpoint(prefix: str) -> Optional[Tuple[int, int]]:
+    """(epoch, batch_in_epoch) of the newest checkpoint, epoch- or
+    mid-epoch; batch 0 means an epoch boundary.  A ``step_E_B`` dump is
+    newer than ``epoch_E`` (it was taken inside epoch E after the
+    boundary save of epoch E) but older than ``epoch_{E+1}``."""
+    if not os.path.isdir(prefix):
+        return None
+    found = [
+        parsed for d in os.listdir(prefix)
+        if (parsed := _parse_ckpt_name(d)) is not None
+    ]
+    if not found:
+        return None
+    # (epoch, batch) lexicographic is exactly the resume order because a
+    # step dump inside epoch E carries epoch index E while the boundary
+    # save at the END of epoch E is named epoch_{E+1}
+    return max(found)
+
+
+def prune_step_checkpoints(prefix: str, up_to_epoch: int) -> None:
+    """Delete ``step_E_B`` preemption dumps with E ≤ ``up_to_epoch`` —
+    they are superseded once ``epoch_{E+1}`` exists.  Without pruning, a
+    long run on a preemptible pool accumulates one full params+momentum
+    dump per preemption."""
+    import shutil
+
+    if not os.path.isdir(prefix):
+        return
+    for d in os.listdir(prefix):
+        parsed = _parse_ckpt_name(d)
+        if parsed is None or parsed[1] == 0:
+            continue
+        if parsed[0] <= up_to_epoch:
+            shutil.rmtree(os.path.join(prefix, d), ignore_errors=True)
+
+
+class PreemptionGuard:
+    """Convert SIGTERM/SIGINT into a clean 'stop after this step' flag.
+
+    Usage::
+
+        guard = PreemptionGuard()          # installs handlers
+        for batch in loader:
+            ...
+            if guard.should_stop:
+                save_checkpoint(prefix, state, epoch, batch_idx)
+                return
+
+    The first signal sets the flag; a second signal falls through to the
+    previous handler (so a stuck run can still be killed).
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.should_stop = False
+        self._prev = {}
+        for s in signals:
+            self._prev[s] = signal.signal(s, self._handle)
+
+    def _handle(self, signum, frame):
+        if self.should_stop:  # second signal: escalate
+            prev = self._prev.get(signum, signal.SIG_DFL)
+            signal.signal(signum, prev)
+            os.kill(os.getpid(), signum)
+            return
+        self.should_stop = True
+
+    def uninstall(self):
+        for s, h in self._prev.items():
+            signal.signal(s, h)

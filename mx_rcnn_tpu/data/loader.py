@@ -129,6 +129,7 @@ class TrainLoader:
         seed: int = 0,
         prefetch: int = 2,
         proposal_count: int = 0,
+        row_slice: Optional[slice] = None,
     ):
         self.roidb = roidb
         self.cfg = cfg
@@ -137,7 +138,15 @@ class TrainLoader:
         self.seed = seed
         self.prefetch = prefetch
         self.proposal_count = proposal_count
+        # multi-host: every process computes the identical (seeded) global
+        # plan, then loads only its rows of each global batch — the global
+        # data order is process-count-invariant (parallel/distributed.py)
+        self.row_slice = row_slice
         self.epoch = 0
+        # consumed by the next __iter__: resume-from-preemption skips the
+        # batches already trained this epoch (the plan is deterministic
+        # per (seed, epoch), so skipping reproduces the exact stream)
+        self.skip_batches = 0
 
     def __len__(self) -> int:
         return len(self.roidb) // self.batch_size
@@ -166,6 +175,11 @@ class TrainLoader:
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         plan = self._epoch_plan(self.epoch)
         self.epoch += 1
+        if self.skip_batches:
+            plan = plan[self.skip_batches:]
+            self.skip_batches = 0
+        if self.row_slice is not None:
+            plan = [(b, idxs[self.row_slice]) for b, idxs in plan]
         pc = self.proposal_count
         if self.prefetch <= 0:
             for bucket, idxs in plan:

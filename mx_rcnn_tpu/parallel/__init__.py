@@ -1,3 +1,4 @@
+from mx_rcnn_tpu.parallel import distributed
 from mx_rcnn_tpu.parallel.mesh import (
     make_mesh,
     make_parallel_train_step,

@@ -1,0 +1,113 @@
+"""Multi-host (multi-process) distributed training support.
+
+Reference: MXNet KVStore's ``dist_sync`` mode — the parameter-server path
+``train_end2end.py`` never used (it hardcodes ``kvstore='device'``,
+SURVEY §3.3 "Multi-node distributed: capability exists but unused").
+This module is where the rebuild *exceeds* the reference: the same
+``shard_map`` train step scales from one chip to a multi-host pod because
+the mesh may span processes — XLA lowers the gradient ``psum`` to ICI
+all-reduces within a slice and DCN collectives across slices; there is no
+parameter server, no NCCL/MPI plumbing, no rank-conditional code in the
+train loop.
+
+The host-side contract for multi-process JAX:
+
+- every process calls :func:`initialize` first (GRPC coordinator), then
+  ``jax.devices()`` returns the *global* device list and the mesh built
+  over it spans the pod;
+- every process runs the SAME program over the same global batch
+  *specification*, but only materialises the shard of the data its local
+  devices own — :func:`globalize_batch` assembles a global
+  ``jax.Array`` view from process-local numpy shards
+  (``jax.make_array_from_process_local_data``);
+- :func:`process_slice` tells the data loader which slice of the global
+  batch this process must produce.  Determinism: every process computes
+  the identical global shuffle plan (seeded per epoch) and takes its
+  slice, so the global batch order is independent of process count — the
+  same invariant the single-chip/DP-equivalence tests assert for devices.
+
+On a single process all of this degrades to plain ``device_put`` with no
+coordinator, so the e2e trainer uses one code path everywhere.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-process JAX runtime (no-op when single-process).
+
+    ``coordinator`` is ``host:port`` of process 0.  On TPU pods the three
+    arguments are usually discovered from the environment and may all be
+    None; on CPU/GPU fleets pass them explicitly.  Must be called before
+    the first ``jax.devices()``.
+    """
+    if coordinator is None and num_processes is None:
+        if process_id is not None:
+            raise ValueError(
+                "distributed: --dist_procid given without "
+                "--dist_coordinator/--dist_nprocs — refusing to train as "
+                "an independent single process"
+            )
+        return  # single-process run
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "distributed: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def process_slice(global_batch: int) -> slice:
+    """The [start, stop) rows of the global batch this process loads.
+
+    The global batch is laid out contiguously by process: with P
+    processes each owning L = global/P addressable rows, process p loads
+    rows [p*L, (p+1)*L).  Matches the row→device placement
+    :func:`globalize_batch` produces.
+    """
+    pc, pi = jax.process_count(), jax.process_index()
+    if global_batch % pc:
+        raise ValueError(f"global batch {global_batch} not divisible by {pc} processes")
+    local = global_batch // pc
+    return slice(pi * local, (pi + 1) * local)
+
+
+def globalize_batch(
+    local_batch: Dict[str, np.ndarray], mesh: Mesh
+) -> Dict[str, jax.Array]:
+    """Per-process numpy shards → one global jax.Array batch on the mesh.
+
+    Each array's leading axis is the *local* batch; the result's leading
+    axis is the global batch, sharded over the mesh's 'data' axis.  On a
+    single process this is exactly ``device_put`` with a P('data') spec.
+    """
+    sharding = NamedSharding(mesh, P("data"))
+    return {
+        k: jax.make_array_from_process_local_data(sharding, v)
+        for k, v in local_batch.items()
+    }
+
+
+def local_global_batch_sizes(per_chip: int) -> tuple[int, int]:
+    """(local, global) batch sizes for ``per_chip`` images per device."""
+    return (
+        per_chip * jax.local_device_count(),
+        per_chip * jax.device_count(),
+    )

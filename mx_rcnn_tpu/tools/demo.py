@@ -71,7 +71,9 @@ def demo_net(
 
 
 def main():
-    logging.basicConfig(level=logging.INFO, force=True)
+    from mx_rcnn_tpu.utils.platform import cli_bootstrap
+
+    cli_bootstrap()
     p = argparse.ArgumentParser(description="Single-image demo")
     p.add_argument("--network", default="resnet",
                    choices=["vgg", "resnet", "resnet50", "resnet_fpn", "mask_resnet_fpn"])

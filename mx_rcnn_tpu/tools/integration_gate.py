@@ -155,7 +155,9 @@ def run_gate(
 
 
 def main():
-    logging.basicConfig(level=logging.INFO, force=True)
+    from mx_rcnn_tpu.utils.platform import cli_bootstrap
+
+    cli_bootstrap()
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=400)
     p.add_argument("--num_images", type=int, default=8)

@@ -55,9 +55,9 @@ def main():
     from mx_rcnn_tpu.ops.proposal import propose
     from mx_rcnn_tpu.ops.roi_align import extract_roi_features_batched
     from mx_rcnn_tpu.ops.targets import assign_anchor, sample_rois
-    from mx_rcnn_tpu.utils.platform import enable_compile_cache
+    from mx_rcnn_tpu.utils.platform import cli_bootstrap
 
-    enable_compile_cache()
+    cli_bootstrap()
 
     cfg = _flagship_cfg()
     cfg = cfg.replace(network=dataclasses.replace(cfg.network, COMPUTE_DTYPE=args.dtype))

@@ -30,7 +30,6 @@ def reeval(imdb, detections_path: str):
 
 
 def main():
-    logging.basicConfig(level=logging.INFO, force=True)
     p = argparse.ArgumentParser(description="Re-score saved detections")
     p.add_argument("--network", default="resnet",
                    choices=["vgg", "resnet", "resnet50"])

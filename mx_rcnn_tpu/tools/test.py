@@ -111,7 +111,9 @@ def test_rcnn(args):
 
 
 def main():
-    logging.basicConfig(level=logging.INFO, force=True)
+    from mx_rcnn_tpu.utils.platform import cli_bootstrap
+
+    cli_bootstrap()
     test_rcnn(parse_args())
 
 

@@ -63,7 +63,9 @@ def test_rpn(
 
 
 def main():
-    logging.basicConfig(level=logging.INFO, force=True)
+    from mx_rcnn_tpu.utils.platform import cli_bootstrap
+
+    cli_bootstrap()
     p = argparse.ArgumentParser(description="RPN proposal dump + recall eval")
     p.add_argument("--network", default="resnet",
                    choices=["vgg", "resnet", "resnet50"])

@@ -109,7 +109,9 @@ def alternate_train(
 
 
 def main():
-    logging.basicConfig(level=logging.INFO, force=True)
+    from mx_rcnn_tpu.utils.platform import cli_bootstrap
+
+    cli_bootstrap()
     p = argparse.ArgumentParser(description="4-stage alternate training")
     p.add_argument("--network", default="resnet",
                    choices=["vgg", "resnet", "resnet50"])

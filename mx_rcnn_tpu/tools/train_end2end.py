@@ -20,7 +20,13 @@ import jax
 import numpy as np
 
 from mx_rcnn_tpu.config import generate_config
-from mx_rcnn_tpu.core.checkpoint import latest_epoch, load_checkpoint, save_checkpoint
+from mx_rcnn_tpu.core.checkpoint import (
+    PreemptionGuard,
+    latest_checkpoint,
+    load_checkpoint,
+    prune_step_checkpoints,
+    save_checkpoint,
+)
 from mx_rcnn_tpu.core.metrics import MetricTracker, Speedometer
 from mx_rcnn_tpu.core.train import (
     create_train_state,
@@ -31,10 +37,10 @@ from mx_rcnn_tpu.core.train import (
 from mx_rcnn_tpu.data.loader import TrainLoader
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.parallel import (
+    distributed,
     make_mesh,
     make_parallel_train_step,
     replicate,
-    shard_batch,
 )
 from mx_rcnn_tpu.utils.load_data import load_gt_roidb
 
@@ -69,6 +75,14 @@ def parse_args(argv=None):
                    help="stop after N steps (smoke runs)")
     p.add_argument("--cpu", type=int, default=0, metavar="N",
                    help="force the host backend with N virtual devices")
+    p.add_argument("--dist_coordinator", default=None, metavar="HOST:PORT",
+                   help="multi-host training: process 0's coordinator "
+                        "address (jax.distributed); on TPU pods usually "
+                        "auto-discovered, so --dist_nprocs alone suffices")
+    p.add_argument("--dist_nprocs", type=int, default=None,
+                   help="multi-host training: total number of processes")
+    p.add_argument("--dist_procid", type=int, default=None,
+                   help="multi-host training: this process's id")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of steps 10-20 into "
                         "DIR (view with tensorboard/xprof)")
@@ -78,10 +92,24 @@ def parse_args(argv=None):
 def train_net(args):
     import dataclasses
 
-    if args.cpu:
-        from mx_rcnn_tpu.utils.platform import force_cpu
+    from mx_rcnn_tpu.utils.platform import cli_bootstrap
 
+    cli_bootstrap()
+    # order matters: platform selection must not probe devices before the
+    # coordinator handshake, and the handshake must precede the first
+    # backend initialization
+    if args.cpu:
+        from mx_rcnn_tpu.utils.platform import force_cpu, set_cpu_platform
+
+        set_cpu_platform(args.cpu)
+        distributed.initialize(
+            args.dist_coordinator, args.dist_nprocs, args.dist_procid
+        )
         force_cpu(args.cpu)
+    else:
+        distributed.initialize(
+            args.dist_coordinator, args.dist_nprocs, args.dist_procid
+        )
 
     cfg = generate_config(args.network, args.dataset)
     overrides = {}
@@ -109,8 +137,8 @@ def train_net(args):
     n_chips = len(jax.devices())
     per_chip = cfg.TRAIN.BATCH_IMAGES
     global_batch = per_chip * n_chips
-    logger.info("devices=%d per_chip_batch=%d global_batch=%d",
-                n_chips, per_chip, global_batch)
+    logger.info("devices=%d (%d local) per_chip_batch=%d global_batch=%d",
+                n_chips, jax.local_device_count(), per_chip, global_batch)
 
     _, roidb = load_gt_roidb(
         cfg,
@@ -122,6 +150,10 @@ def train_net(args):
     loader = TrainLoader(
         roidb, cfg, global_batch,
         shuffle=cfg.TRAIN.SHUFFLE and not args.no_shuffle, seed=args.seed,
+        row_slice=(
+            distributed.process_slice(global_batch)
+            if jax.process_count() > 1 else None
+        ),
     )
     steps_per_epoch = max(len(loader), 1)
 
@@ -152,15 +184,42 @@ def train_net(args):
     tx = make_optimizer(cfg, make_lr_schedule(cfg, steps_per_epoch))
     state = create_train_state(params, tx)
     begin_epoch = 0
+    begin_batch = 0
     if args.resume:
-        last = latest_epoch(args.prefix)
+        multi = jax.process_count() > 1
+        last = latest_checkpoint(args.prefix)
+        if multi:
+            # checkpoints are written by process 0 only; on per-host disks
+            # the others may see nothing (or stale dirs), so the resume
+            # point is process 0's decision everywhere — divergent
+            # epoch/batch counters would desync the collectives
+            from jax.experimental import multihost_utils
+
+            agreed = multihost_utils.broadcast_one_to_all(
+                np.asarray(last if last is not None else (-1, -1), np.int32)
+            )
+            last = tuple(int(x) for x in agreed)
+            if last == (-1, -1):
+                last = None
         if last is not None:
-            state = load_checkpoint(args.prefix, last, state)
-            begin_epoch = last
+            epoch, begin_batch = last
+            if not multi or jax.process_index() == 0:
+                state = load_checkpoint(args.prefix, epoch, state, begin_batch)
+            if multi:
+                # ship process 0's restored state to hosts whose local
+                # disk has no checkpoint (all processes must enter
+                # replicate() with identical values)
+                state = multihost_utils.broadcast_one_to_all(
+                    jax.device_get(state)
+                )
+            begin_epoch = epoch
             # replay the same shuffle stream a fresh run would have used
-            # at this epoch (the loader keys its RNG on seed + epoch)
+            # at this epoch (the loader keys its RNG on seed + epoch);
+            # a mid-epoch (preemption) checkpoint additionally skips the
+            # batches already consumed
             loader.epoch = begin_epoch
-            logger.info("resumed from epoch %d", last)
+            loader.skip_batches = begin_batch
+            logger.info("resumed from epoch %d batch %d", epoch, begin_batch)
 
     use_mesh = n_chips > 1
     if use_mesh:
@@ -172,48 +231,96 @@ def train_net(args):
 
     from mx_rcnn_tpu.utils.run_meta import save_run_meta
 
-    save_run_meta(args.prefix, cfg)
+    if jax.process_index() == 0:
+        save_run_meta(args.prefix, cfg)
+
+    STOP_VOTE_EVERY = 10
+
+    def _stop_agreed(local_stop: bool, step: int) -> bool:
+        """Preemption is delivered per-process; every process must agree
+        on the stop step or the others hang in the next collective.
+        Multi-host, the vote is a blocking cross-host allgather, so it
+        runs every STOP_VOTE_EVERY steps (same step on every process —
+        ``step`` is process-invariant) rather than every step; preemption
+        grace periods are tens of seconds, so the added latency is noise."""
+        if jax.process_count() == 1:
+            return local_stop
+        if step % STOP_VOTE_EVERY:
+            return False
+        from jax.experimental import multihost_utils
+
+        votes = multihost_utils.process_allgather(
+            np.asarray(local_stop, np.int32)
+        )
+        return bool(np.asarray(votes).any())
 
     tracker = MetricTracker()
     speedo = Speedometer(global_batch, args.frequent)
     rng = jax.random.key(args.seed + 123)
     total_steps = 0
-    for epoch in range(begin_epoch, args.epochs):
-        for batch in loader:
-            if use_mesh:
-                batch = shard_batch(batch, mesh)
-            # profiler window: skip compile/warmup, capture steady state
-            # (SURVEY §5.2 — the reference had only a Speedometer)
-            if args.profile and total_steps == 10:
-                jax.profiler.start_trace(args.profile)
-            state, aux = step_fn(state, batch, rng)
-            tracker.update({k: float(v) for k, v in jax.device_get(aux).items()})
-            total_steps += 1
-            if args.profile and total_steps == 20:
-                jax.profiler.stop_trace()
-                logger.info("profiler trace written to %s", args.profile)
-            speedo(epoch, total_steps, tracker)
+    tracing = False
+    preempted = False
+    guard = PreemptionGuard()
+    try:
+        for epoch in range(begin_epoch, args.epochs):
+            batch_in_epoch = begin_batch if epoch == begin_epoch else 0
+            for batch in loader:
+                if use_mesh:
+                    batch = distributed.globalize_batch(batch, mesh)
+                # profiler window: skip compile/warmup, capture steady
+                # state (SURVEY §5.2 — the reference had a Speedometer)
+                if args.profile and total_steps == 10:
+                    jax.profiler.start_trace(args.profile)
+                    tracing = True
+                state, aux = step_fn(state, batch, rng)
+                tracker.update(
+                    {k: float(v) for k, v in jax.device_get(aux).items()}
+                )
+                total_steps += 1
+                batch_in_epoch += 1
+                if args.profile and total_steps == 20:
+                    jax.profiler.stop_trace()
+                    tracing = False
+                    logger.info("profiler trace written to %s", args.profile)
+                speedo(epoch, total_steps, tracker)
+                if _stop_agreed(guard.should_stop, total_steps):
+                    # preemption: mid-epoch checkpoint resume picks up
+                    preempted = True
+                    if jax.process_index() == 0:
+                        path = save_checkpoint(
+                            args.prefix, jax.device_get(state),
+                            epoch, batch_in_epoch,
+                        )
+                        logger.info(
+                            "preempted at epoch %d batch %d — checkpoint -> %s",
+                            epoch, batch_in_epoch, path,
+                        )
+                    break
+                if args.max_steps and total_steps >= args.max_steps:
+                    break
+            if preempted:
+                break
+            if jax.process_index() == 0:
+                path = save_checkpoint(
+                    args.prefix, jax.device_get(state), epoch + 1
+                )
+                logger.info("Epoch[%d] checkpoint -> %s", epoch, path)
+                # preemption dumps from this epoch are now superseded
+                prune_step_checkpoints(args.prefix, epoch)
             if args.max_steps and total_steps >= args.max_steps:
                 break
-        path = save_checkpoint(args.prefix, jax.device_get(state), epoch + 1)
-        logger.info("Epoch[%d] checkpoint -> %s", epoch, path)
-        if args.max_steps and total_steps >= args.max_steps:
-            break
-    if args.profile and 10 < total_steps < 20:
-        # run ended inside the capture window — flush what we have
-        jax.profiler.stop_trace()
-        logger.info("profiler trace (short run) written to %s", args.profile)
+    finally:
+        guard.uninstall()
+        if tracing:
+            # run ended inside the capture window — flush what we have
+            jax.profiler.stop_trace()
+            logger.info(
+                "profiler trace (short run) written to %s", args.profile
+            )
     return state
 
 
 def main():
-    # force=True: jax/absl pre-install a root handler at WARNING, which
-    # would silently swallow these INFO logs
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-        force=True,
-    )
     train_net(parse_args())
 
 

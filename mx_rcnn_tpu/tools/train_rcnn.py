@@ -55,7 +55,9 @@ def train_rcnn(
 
 
 def main():
-    logging.basicConfig(level=logging.INFO, force=True)
+    from mx_rcnn_tpu.utils.platform import cli_bootstrap
+
+    cli_bootstrap()
     p = argparse.ArgumentParser(description="Train Fast-RCNN on proposals")
     p.add_argument("--network", default="resnet",
                    choices=["vgg", "resnet", "resnet50"])

@@ -45,7 +45,9 @@ def train_rpn(
 
 
 def main():
-    logging.basicConfig(level=logging.INFO, force=True)
+    from mx_rcnn_tpu.utils.platform import cli_bootstrap
+
+    cli_bootstrap()
     p = argparse.ArgumentParser(description="Train RPN only")
     p.add_argument("--network", default="resnet",
                    choices=["vgg", "resnet", "resnet50"])

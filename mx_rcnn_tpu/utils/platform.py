@@ -23,6 +23,20 @@ def enable_compile_cache(path: str = "/tmp/jax_cache") -> None:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 
+def cli_bootstrap() -> None:
+    """Shared entry-point preamble for every tool main(): persistent
+    compile cache + INFO logging (force=True — jax/absl pre-install a
+    root handler at WARNING that would swallow the logs)."""
+    import logging
+
+    enable_compile_cache()
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        force=True,
+    )
+
+
 def use_pallas() -> bool:
     """Pallas kernels on TPU-class backends, jnp fallbacks elsewhere.
     Override with MX_RCNN_TPU_PALLAS=0/1."""
@@ -38,12 +52,13 @@ def use_pallas() -> bool:
     return platform in ("tpu", "axon")
 
 
-def force_cpu(n_devices: int = 1) -> None:
-    """Switch JAX to the host CPU backend with ``n_devices`` virtual
-    devices.  Must run before the first backend initialization in this
-    process (XLA parses XLA_FLAGS exactly once, at first client init)."""
+def set_cpu_platform(n_devices: int = 1) -> None:
+    """Point JAX at the host backend with ``n_devices`` virtual devices
+    WITHOUT touching the backend (no device probe) — the half of
+    :func:`force_cpu` that may safely run before
+    ``jax.distributed.initialize`` (which itself must precede the first
+    backend initialization)."""
     import jax
-    from jax._src import xla_bridge as xb
 
     if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
         os.environ["XLA_FLAGS"] = (
@@ -51,6 +66,16 @@ def force_cpu(n_devices: int = 1) -> None:
             + f" --xla_force_host_platform_device_count={n_devices}"
         )
     jax.config.update("jax_platforms", "cpu")
+
+
+def force_cpu(n_devices: int = 1) -> None:
+    """Switch JAX to the host CPU backend with ``n_devices`` virtual
+    devices.  Must run before the first backend initialization in this
+    process (XLA parses XLA_FLAGS exactly once, at first client init)."""
+    import jax
+    from jax._src import xla_bridge as xb
+
+    set_cpu_platform(n_devices)
     if xb.backends_are_initialized():
         from jax.extend.backend import clear_backends
 

@@ -151,3 +151,50 @@ def test_spatial_sharding_matches_unsharded():
     np.testing.assert_allclose(
         np.asarray(got), expected, rtol=2e-4, atol=2e-4
     )
+
+
+def test_globalize_batch_matches_shard_batch():
+    """Single-process multi-host path: make_array_from_process_local_data
+    must produce the same sharded global batch device_put does."""
+    from mx_rcnn_tpu.parallel.distributed import (
+        globalize_batch,
+        local_global_batch_sizes,
+        process_slice,
+    )
+
+    mesh = make_mesh()
+    batch = {
+        "images": np.random.RandomState(0).rand(8, 16, 16, 3).astype(np.float32),
+        "sample_seeds": np.arange(8, dtype=np.int32),
+    }
+    a = globalize_batch(batch, mesh)
+    b = shard_batch(batch, mesh)
+    for k in batch:
+        assert a[k].sharding == b[k].sharding
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+    # single process owns the whole global batch
+    assert process_slice(8) == slice(0, 8)
+    assert local_global_batch_sizes(2) == (16, 16)
+
+
+def test_loader_row_slice_is_deterministic_sub_batch():
+    """A row-sliced loader must yield exactly the slice of the full
+    loader's batches (the multi-host per-process data contract)."""
+    from mx_rcnn_tpu.data.loader import TrainLoader
+    from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+    from tests.test_model import tiny_cfg
+
+    cfg = tiny_cfg()
+    cfg = cfg.replace(
+        SHAPE_BUCKETS=((128, 128),),
+        dataset=dataclasses.replace(cfg.dataset, SCALES=((128, 128),)),
+    )
+    roidb = SyntheticDataset(
+        num_images=8, num_classes=4, image_size=(128, 128), max_boxes=2
+    ).gt_roidb()
+    full = TrainLoader(roidb, cfg, 4, seed=3, prefetch=0)
+    part = TrainLoader(roidb, cfg, 4, seed=3, prefetch=0,
+                       row_slice=slice(2, 4))
+    for fb, pb in zip(full, part):
+        for k in fb:
+            np.testing.assert_array_equal(fb[k][2:4], pb[k])

@@ -1,0 +1,91 @@
+"""Preemption-safe checkpointing (SURVEY §5.4 upgrade: the reference had
+manual epoch-granular restart only)."""
+
+import os
+import signal
+
+import jax.numpy as jnp
+import numpy as np
+
+from mx_rcnn_tpu.core.checkpoint import (
+    PreemptionGuard,
+    latest_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from mx_rcnn_tpu.core.train import TrainState
+
+
+def _state(v: float) -> TrainState:
+    return TrainState(
+        jnp.asarray(int(v), jnp.int32),
+        {"w": np.full((3,), v, np.float32)},
+        (),
+    )
+
+
+def test_latest_checkpoint_ordering(tmp_path):
+    p = str(tmp_path)
+    os.makedirs(os.path.join(p, "epoch_0001"))
+    assert latest_checkpoint(p) == (1, 0)
+    # a preemption dump inside epoch 1 is newer than epoch_0001
+    os.makedirs(os.path.join(p, "step_0001_000042"))
+    assert latest_checkpoint(p) == (1, 42)
+    # the next epoch boundary is newer still
+    os.makedirs(os.path.join(p, "epoch_0002"))
+    assert latest_checkpoint(p) == (2, 0)
+    assert latest_checkpoint(str(tmp_path / "missing")) is None
+
+
+def test_step_checkpoint_roundtrip(tmp_path):
+    p = str(tmp_path / "ckpt")
+    save_checkpoint(p, _state(7.0), epoch=2, batch_in_epoch=5)
+    assert latest_checkpoint(p) == (2, 5)
+    got = load_checkpoint(p, 2, _state(0.0), batch_in_epoch=5)
+    np.testing.assert_array_equal(np.asarray(got.params["w"]), 7.0)
+    assert int(got.step) == 7
+
+
+def test_preemption_guard_sets_flag_once():
+    guard = PreemptionGuard(signals=(signal.SIGUSR1,))
+    try:
+        assert not guard.should_stop
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert guard.should_stop
+    finally:
+        guard.uninstall()
+
+
+def test_loader_skip_batches_resumes_stream():
+    """skip_batches=N must reproduce the tail of the same epoch's plan."""
+    from mx_rcnn_tpu.data.loader import TrainLoader
+    from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+    from tests.test_loader import small_cfg
+
+    cfg = small_cfg()
+    roidb = SyntheticDataset(
+        num_images=8, num_classes=4, image_size=(128, 128), max_boxes=2
+    ).gt_roidb()
+    full = TrainLoader(roidb, cfg, 2, shuffle=True, seed=11, prefetch=0)
+    want = list(full)[2:]  # epoch-0 batches 2..
+
+    resumed = TrainLoader(roidb, cfg, 2, shuffle=True, seed=11, prefetch=0)
+    resumed.skip_batches = 2
+    got = list(resumed)
+    assert len(got) == len(want)
+    for a, b in zip(got, want):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_prune_step_checkpoints(tmp_path):
+    import os
+
+    from mx_rcnn_tpu.core.checkpoint import prune_step_checkpoints
+
+    p = str(tmp_path)
+    for d in ["epoch_0001", "step_0001_000003", "step_0002_000007", "junk"]:
+        os.makedirs(os.path.join(p, d))
+    prune_step_checkpoints(p, up_to_epoch=1)
+    left = sorted(os.listdir(p))
+    assert left == ["epoch_0001", "junk", "step_0002_000007"]
