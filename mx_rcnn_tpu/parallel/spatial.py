@@ -52,3 +52,24 @@ def shard_images_spatial(images, mesh: Mesh):
     """Place (B, H, W, C) images with B on 'data' and H on 'model'."""
     img_sharding, _ = spatial_shardings(mesh)
     return jax.device_put(images, img_sharding)
+
+
+def shard_batch_spatial(batch, mesh: Mesh):
+    """Place a full train batch for context-parallel training: images
+    sharded (B→'data', H→'model'), every other array (gt, im_info,
+    seeds) batch-sharded only.
+
+    Feeding this placement to the ordinary jitted train step is the whole
+    mechanism: jit propagates input shardings, so XLA spatially partitions
+    every backbone/RPN conv (halo exchanges on 'model') and inserts the
+    gather where the proposal top-k needs the full feature map — the same
+    graph scales past single-chip activation memory with no model-code
+    changes.  The detector analog of sequence/context parallelism for
+    long sequences (SURVEY §5.7).
+    """
+    img_sharding, _ = spatial_shardings(mesh)
+    row_sharding = NamedSharding(mesh, P("data"))
+    return {
+        k: jax.device_put(v, img_sharding if k == "images" else row_sharding)
+        for k, v in batch.items()
+    }
