@@ -171,6 +171,7 @@ NETWORKS: Dict[str, NetworkConfig] = {
     ),
     "resnet": NetworkConfig(name="resnet", depth=101),
     "resnet50": NetworkConfig(name="resnet", depth=50),
+    "resnet152": NetworkConfig(name="resnet", depth=152),
     "resnet_fpn": NetworkConfig(
         name="resnet",
         depth=50,

@@ -76,7 +76,7 @@ def main():
     cli_bootstrap()
     p = argparse.ArgumentParser(description="Single-image demo")
     p.add_argument("--network", default="resnet",
-                   choices=["vgg", "resnet", "resnet50", "resnet_fpn", "mask_resnet_fpn"])
+                   choices=["vgg", "resnet", "resnet50", "resnet152", "resnet_fpn", "mask_resnet_fpn"])
     p.add_argument("--dataset", default="PascalVOC",
                    choices=["PascalVOC", "PascalVOC0712", "coco"])
     p.add_argument("--image", required=True)

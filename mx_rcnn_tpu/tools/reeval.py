@@ -32,7 +32,7 @@ def reeval(imdb, detections_path: str):
 def main():
     p = argparse.ArgumentParser(description="Re-score saved detections")
     p.add_argument("--network", default="resnet",
-                   choices=["vgg", "resnet", "resnet50"])
+                   choices=["vgg", "resnet", "resnet50", "resnet152"])
     p.add_argument("--dataset", default="PascalVOC",
                    choices=["PascalVOC", "PascalVOC0712", "coco"])
     p.add_argument("--image_set", default=None, help="defaults to the test set")

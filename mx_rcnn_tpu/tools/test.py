@@ -30,7 +30,7 @@ logger = logging.getLogger(__name__)
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="Evaluate Faster R-CNN")
     p.add_argument("--network", default="resnet",
-                   choices=["vgg", "resnet", "resnet50", "resnet_fpn", "mask_resnet_fpn"])
+                   choices=["vgg", "resnet", "resnet50", "resnet152", "resnet_fpn", "mask_resnet_fpn"])
     p.add_argument("--dataset", default="PascalVOC",
                    choices=["PascalVOC", "PascalVOC0712", "coco"])
     p.add_argument("--image_set", default=None, help="defaults to the test split")

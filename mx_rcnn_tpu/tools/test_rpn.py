@@ -68,7 +68,7 @@ def main():
     cli_bootstrap()
     p = argparse.ArgumentParser(description="RPN proposal dump + recall eval")
     p.add_argument("--network", default="resnet",
-                   choices=["vgg", "resnet", "resnet50"])
+                   choices=["vgg", "resnet", "resnet50", "resnet152"])
     p.add_argument("--dataset", default="PascalVOC",
                    choices=["PascalVOC", "PascalVOC0712", "coco"])
     p.add_argument("--image_set", default=None)

@@ -50,7 +50,7 @@ logger = logging.getLogger(__name__)
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="Train Faster R-CNN end-to-end")
     p.add_argument("--network", default="resnet",
-                   choices=["vgg", "resnet", "resnet50", "resnet_fpn", "mask_resnet_fpn"])
+                   choices=["vgg", "resnet", "resnet50", "resnet152", "resnet_fpn", "mask_resnet_fpn"])
     p.add_argument("--dataset", default="PascalVOC",
                    choices=["PascalVOC", "PascalVOC0712", "coco"])
     p.add_argument("--image_set", default=None)

@@ -187,3 +187,19 @@ class TestOverfit:
         last = np.mean(losses[-5:])
         assert np.isfinite(losses).all()
         assert last < first * 0.7, f"loss did not drop: {first:.3f} -> {last:.3f}"
+
+
+def test_resnet152_registry_and_forward():
+    """resnet152 is selectable (same graph family, (3, 8, 36, 3) blocks)
+    and its backbone produces the standard stride-16 C4 feature map."""
+    import jax
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.models.resnet import ResNetBackbone
+
+    cfg = generate_config("resnet152", "PascalVOC")
+    assert cfg.network.depth == 152
+    bb = ResNetBackbone(depth=152)
+    x = np.zeros((1, 64, 64, 3), np.float32)
+    feat = bb.apply(bb.init(jax.random.key(0), x), x)
+    assert feat.shape == (1, 4, 4, 1024)

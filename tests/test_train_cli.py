@@ -53,3 +53,16 @@ def test_train_end2end_smoke_and_resume(tmp_path, monkeypatch):
     state2 = cli.train_net(cli.parse_args(argv[:7] + ["2"] + argv[8:] + ["--resume"]))
     assert int(np.asarray(state2.step)) == 2 * steps_per_epoch
     assert latest_checkpoint(prefix) == (2, 0)
+
+    # the eval CLI consumes the checkpoint this trainer wrote
+    # (reference: test.py + rcnn/tools/test_rcnn.py)
+    from mx_rcnn_tpu.tools import test as test_cli
+
+    monkeypatch.setattr(test_cli, "generate_config", _tiny_generate_config)
+    results = test_cli.test_rcnn(test_cli.parse_args([
+        "--network", "resnet50", "--dataset", "PascalVOC",
+        "--synthetic", "8", "--prefix", prefix, "--max_images", "4",
+    ]))
+    assert results, "eval CLI returned no metrics"
+    for k, v in results.items():
+        assert np.isfinite(v) and 0.0 <= v <= 1.0, (k, v)
