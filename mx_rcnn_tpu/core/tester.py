@@ -49,18 +49,19 @@ class Predictor:
 
 
 def im_detect(
-    output: Dict[str, np.ndarray], im_info: np.ndarray, orig_hw
+    output: Dict[str, np.ndarray], im_info: np.ndarray, orig_hw, index: int = 0
 ) -> Dict[str, np.ndarray]:
     """Decode one image's raw head outputs into image-space detections.
 
     Reference: ``rcnn/core/tester.py :: im_detect`` — class-specific
     delta decode, clip to the *resized* image, then divide by scale back
-    to original coordinates.
+    to original coordinates.  ``index`` selects the image within a
+    batched forward's outputs.
     """
-    rois = output["rois"][0]
-    valid = output["roi_valid"][0].astype(bool)
-    scores = output["cls_prob"][0]
-    deltas = output["bbox_deltas"][0]
+    rois = output["rois"][index]
+    valid = output["roi_valid"][index].astype(bool)
+    scores = output["cls_prob"][index]
+    deltas = output["bbox_deltas"][index]
     scale = float(im_info[2])
 
     boxes = np.asarray(bbox_pred(rois, deltas))
@@ -72,7 +73,7 @@ def im_detect(
     det = {"scores": scores[valid], "boxes": boxes[valid]}
     if "mask_logits" in output:  # Mask R-CNN branch: per-roi (S, S, K)
         det["mask_probs"] = 1.0 / (
-            1.0 + np.exp(-np.asarray(output["mask_logits"][0][valid]))
+            1.0 + np.exp(-np.asarray(output["mask_logits"][index][valid]))
         )
     return det
 
@@ -105,9 +106,15 @@ def pred_eval(
     ]
     all_masks: Optional[List[List[list]]] = None
     t0 = time.time()
-    for i, (rec, batch) in enumerate(loader):
-        out = predictor.predict(batch)
-        det = im_detect(out, batch["im_info"][0], (rec["height"], rec["width"]))
+    done = 0
+
+    def process_image(i: int, rec: Dict, out, batch, k: int = 0):
+        """Accumulate detections for dataset image ``i`` from the
+        ``k``-th slot of a (possibly batched) forward's outputs."""
+        nonlocal all_masks, done
+        det = im_detect(
+            out, batch["im_info"][k], (rec["height"], rec["width"]), index=k
+        )
         scores, boxes = det["scores"], det["boxes"]
         with_masks = "mask_probs" in det
         if with_masks and all_masks is None:
@@ -156,10 +163,23 @@ def pred_eval(
             }
             im = draw_detections(_load_record_image(rec), dets_by_class, vis_thresh)
             save_image(os.path.join(vis, f"det_{i:06d}.png"), im)
-        if (i + 1) % 100 == 0:
+        done += 1
+        if done % 100 == 0:
             logger.info(
-                "im_detect %d/%d %.3fs/im", i + 1, num_images, (time.time() - t0) / (i + 1)
+                "im_detect %d/%d %.3fs/im", done, num_images, (time.time() - t0) / done
             )
+
+    if getattr(loader, "batch_size", 1) > 1:
+        # batched device forwards (beyond-reference: the reference tester
+        # is batch=1); dataset order is restored through the indices
+        for idxs, recs, batch in loader.iter_batched():
+            out = predictor.predict(batch)
+            for k, (i, rec) in enumerate(zip(idxs, recs)):
+                process_image(i, rec, out, batch, k)
+    else:
+        for i, (rec, batch) in enumerate(loader):
+            out = predictor.predict(batch)
+            process_image(i, rec, out, batch)
     if dump_path:
         with open(dump_path, "wb") as f:
             pickle.dump(all_boxes, f, pickle.HIGHEST_PROTOCOL)

@@ -46,6 +46,9 @@ def parse_args(argv=None):
                    help="save the all_boxes pickle for tools/reeval.py")
     p.add_argument("--vis", default=None, metavar="DIR",
                    help="render detection overlays into DIR")
+    p.add_argument("--test_batch", type=int, default=1,
+                   help="images per device forward (same-bucket batching; "
+                        "the reference tester was batch=1)")
     return p.parse_args(argv)
 
 
@@ -100,7 +103,7 @@ def test_rcnn(args):
             )
 
     predictor = Predictor(model, params)
-    loader = TestLoader(roidb, cfg)
+    loader = TestLoader(roidb, cfg, batch_size=args.test_batch)
     _, results = pred_eval(
         predictor, loader, imdb, cfg, thresh=args.thresh,
         vis=args.vis, dump_path=args.dump,

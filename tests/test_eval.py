@@ -214,3 +214,65 @@ class TestCOCOSegmEval:
         assert out.sum() == 10 * 10
         r = mask_to_rle(prob, np.array([10, 12, 19, 21]), 40, 40)
         np.testing.assert_array_equal(rle.decode(r), out)
+
+
+class TestBatchedPredEval:
+    def test_batched_matches_batch1(self):
+        """batch_size>1 eval (same-bucket device batching, a
+        beyond-reference upgrade) must reproduce the batch=1 detections
+        image for image."""
+        import dataclasses as dc
+
+        import jax
+
+        from mx_rcnn_tpu.core.tester import Predictor, pred_eval
+        from mx_rcnn_tpu.data.loader import TestLoader
+        from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+        from mx_rcnn_tpu.models import FasterRCNN
+        from tests.test_model import tiny_cfg
+
+        cfg = tiny_cfg()
+        cfg = cfg.replace(
+            SHAPE_BUCKETS=((128, 128),),
+            TEST=dc.replace(cfg.TEST, SCORE_THRESH=0.0),
+            dataset=dc.replace(
+                cfg.dataset, NUM_CLASSES=4, SCALES=((128, 128),), MAX_GT_BOXES=8
+            ),
+        )
+        imdb = SyntheticDataset(
+            num_images=5, num_classes=4, image_size=(128, 128), max_boxes=2
+        )
+        roidb = imdb.gt_roidb()
+        model = FasterRCNN(cfg)
+        rec = roidb[0]
+        import numpy as np
+
+        from mx_rcnn_tpu.data.loader import _orientation_bucket, make_batch
+
+        b0 = make_batch([rec], cfg, _orientation_bucket(rec, cfg.SHAPE_BUCKETS))
+        params = model.init(
+            {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+            train=True, **b0,
+        )["params"]
+
+        class NoEval:
+            num_classes = imdb.num_classes
+            classes = imdb.classes
+
+            def evaluate_detections(self, all_boxes, **kw):
+                return {}
+
+        predictor = Predictor(model, params)
+        ab1, _ = pred_eval(predictor, TestLoader(roidb, cfg), NoEval(), cfg)
+        abN, _ = pred_eval(
+            predictor, TestLoader(roidb, cfg, batch_size=2), NoEval(), cfg
+        )
+        for j in range(1, imdb.num_classes):
+            for i in range(len(roidb)):
+                assert ab1[j][i].shape == abN[j][i].shape, (j, i)
+                # batch-1 vs batched convs differ at the 1e-3 level (XLA
+                # picks different conv schedules per batch size)
+                np.testing.assert_allclose(
+                    abN[j][i], ab1[j][i], rtol=2e-3, atol=2e-3,
+                    err_msg=f"class {j} image {i}",
+                )
