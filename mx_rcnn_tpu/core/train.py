@@ -96,30 +96,74 @@ def create_train_state(params, tx: optax.GradientTransformation) -> TrainState:
 
 
 def make_train_step(
-    model, tx: optax.GradientTransformation, donate: bool = True, pmean_axis: str | None = None
+    model,
+    tx: optax.GradientTransformation,
+    donate: bool = True,
+    pmean_axis: str | None = None,
+    accum_steps: int = 1,
 ):
     """Build the jitted train step.
 
     ``pmean_axis``: when running under shard_map/pmap, the named mesh axis
     to average grads/metrics over (the KVStore('device') replacement);
     None for single-chip.
+
+    ``accum_steps`` > 1 splits the batch's leading axis into that many
+    microbatches and averages their gradients under ``lax.scan`` before
+    the single optimizer update — the big-effective-batch path when
+    activations don't fit (the reference had no analog).  With per-image
+    ``sample_seeds`` in the batch the update equals the unaccumulated
+    step exactly (same linearity argument as DP equivalence).
     """
 
-    def step_fn(state: TrainState, batch: Dict[str, jnp.ndarray], rng: jax.Array):
-        rng = jax.random.fold_in(rng, state.step)
-
-        def loss_fn(params):
+    def _grads_and_aux(params, batch, rng):
+        def loss_fn(p):
             # batch keys match the model __call__ signature (images,
             # im_info, gt_boxes, gt_valid [, proposals, prop_valid]) so
             # one step builder serves FasterRCNN / RPNOnly / FastRCNN
             loss, aux = model.apply(
-                {"params": params}, train=True, rngs={"sampling": rng}, **batch
+                {"params": p}, train=True, rngs={"sampling": rng}, **batch
             )
             return loss, aux
 
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         aux = dict(aux)
         aux["loss"] = loss
+        return grads, aux
+
+    def step_fn(state: TrainState, batch: Dict[str, jnp.ndarray], rng: jax.Array):
+        rng = jax.random.fold_in(rng, state.step)
+
+        if accum_steps == 1:
+            grads, aux = _grads_and_aux(state.params, batch, rng)
+        else:
+            micro = jax.tree_util.tree_map(
+                lambda x: x.reshape((accum_steps, -1) + x.shape[1:]), dict(batch)
+            )
+            # same DP-equivalence convention as parallel/mesh.py: batches
+            # carrying per-image sample_seeds draw identically to the
+            # unaccumulated step from ONE shared rng; seedless batches
+            # decorrelate microbatches by folding in the index
+            if "sample_seeds" in batch:
+                rngs = jnp.broadcast_to(
+                    jax.random.key_data(rng),
+                    (accum_steps,) + jax.random.key_data(rng).shape,
+                )
+                rngs = jax.vmap(jax.random.wrap_key_data)(rngs)
+            else:
+                rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+                    jnp.arange(accum_steps)
+                )
+
+            def body(_, inp):
+                mb, r = inp
+                g, aux = _grads_and_aux(state.params, mb, r)
+                aux = {k: v.astype(jnp.float32) for k, v in aux.items()}
+                return None, (g, aux)
+
+            _, (g_stack, aux_stack) = jax.lax.scan(body, None, (micro, rngs))
+            grads = jax.tree_util.tree_map(lambda g: g.mean(0), g_stack)
+            aux = jax.tree_util.tree_map(lambda a: a.mean(0), aux_stack)
         if pmean_axis is not None:
             # Under shard_map, params arrive replicated (device-invariant)
             # while the loss is device-varying, so autodiff's transpose

@@ -52,14 +52,16 @@ def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh):
     return jax.device_put(batch, sharding)
 
 
-def make_parallel_train_step(model, tx, mesh: Mesh):
+def make_parallel_train_step(model, tx, mesh: Mesh, accum_steps: int = 1):
     """The DP train step: per-chip compute + pmean on grads/metrics.
 
     Batch arrays arrive sharded on 'data'; state replicated.  Since the
     grads are pmean-ed inside, the updated state stays replicated — the
     invariant KVStore maintained with explicit broadcasts.
+    ``accum_steps`` applies per chip (each shard is scanned into that
+    many microbatches before its gradient joins the all-reduce).
     """
-    inner = make_train_step(model, tx, pmean_axis="data")
+    inner = make_train_step(model, tx, pmean_axis="data", accum_steps=accum_steps)
 
     state_spec = P()   # replicated
     batch_spec = P("data")

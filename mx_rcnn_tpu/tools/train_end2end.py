@@ -58,6 +58,9 @@ def parse_args(argv=None):
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--batch_images", type=int, default=None, help="per-chip batch")
+    p.add_argument("--grad_accum", type=int, default=1,
+                   help="microbatches per optimizer update (gradient "
+                        "accumulation for big effective batches)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--pretrained", default=None, metavar="CKPT",
                    help="ImageNet backbone checkpoint (.pth/.npz/pickle, "
@@ -136,9 +139,14 @@ def train_net(args):
 
     n_chips = len(jax.devices())
     per_chip = cfg.TRAIN.BATCH_IMAGES
-    global_batch = per_chip * n_chips
-    logger.info("devices=%d (%d local) per_chip_batch=%d global_batch=%d",
-                n_chips, jax.local_device_count(), per_chip, global_batch)
+    # effective images per optimizer update: chips × per-chip microbatch
+    # × accumulated microbatches
+    global_batch = per_chip * n_chips * args.grad_accum
+    logger.info(
+        "devices=%d (%d local) per_chip_batch=%d grad_accum=%d global_batch=%d",
+        n_chips, jax.local_device_count(), per_chip, args.grad_accum,
+        global_batch,
+    )
 
     _, roidb = load_gt_roidb(
         cfg,
@@ -225,9 +233,11 @@ def train_net(args):
     if use_mesh:
         mesh = make_mesh(n_data=n_chips, n_model=1)
         state = replicate(state, mesh)
-        step_fn = make_parallel_train_step(model, tx, mesh)
+        step_fn = make_parallel_train_step(
+            model, tx, mesh, accum_steps=args.grad_accum
+        )
     else:
-        step_fn = make_train_step(model, tx)
+        step_fn = make_train_step(model, tx, accum_steps=args.grad_accum)
 
     from mx_rcnn_tpu.utils.run_meta import save_run_meta
 

@@ -203,3 +203,43 @@ def test_resnet152_registry_and_forward():
     x = np.zeros((1, 64, 64, 3), np.float32)
     feat = bb.apply(bb.init(jax.random.key(0), x), x)
     assert feat.shape == (1, 4, 4, 1024)
+
+
+def test_grad_accum_matches_plain_step():
+    """accum_steps=2 (scan over microbatches, one update) must equal the
+    unaccumulated step exactly when per-image sample_seeds pin the
+    in-graph subsampling (same linearity argument as DP equivalence)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.core.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from mx_rcnn_tpu.models import FasterRCNN
+
+    cfg = tiny_cfg()
+    model = FasterRCNN(cfg)
+    batch = tiny_batch(np.random.RandomState(6), b=4, h=96, w=96)
+    batch["sample_seeds"] = jnp.arange(4, dtype=jnp.int32)
+    params = model.init(
+        {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+        batch["images"][:1], batch["im_info"][:1],
+        batch["gt_boxes"][:1], batch["gt_valid"][:1], train=True,
+    )["params"]
+    tx = make_optimizer(cfg, lambda s: 0.01)
+
+    plain = make_train_step(model, tx, donate=False)
+    accum = make_train_step(model, tx, donate=False, accum_steps=2)
+    p_new, p_aux = plain(create_train_state(params, tx), batch, jax.random.key(3))
+    a_new, a_aux = accum(create_train_state(params, tx), batch, jax.random.key(3))
+
+    assert np.isclose(float(a_aux["loss"]), float(p_aux["loss"]), rtol=1e-5)
+    p_flat = jax.tree_util.tree_flatten_with_path(jax.device_get(p_new.params))[0]
+    a_flat = jax.tree_util.tree_flatten_with_path(jax.device_get(a_new.params))[0]
+    for (path, pv), (_, av) in zip(p_flat, a_flat):
+        np.testing.assert_allclose(
+            np.asarray(av), np.asarray(pv), rtol=1e-4, atol=1e-5,
+            err_msg=f"param mismatch at {jax.tree_util.keystr(path)}",
+        )
