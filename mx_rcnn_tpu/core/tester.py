@@ -6,9 +6,9 @@ loop → per-class NMS → ``imdb.evaluate_detections``), and
 ``generate_proposals`` (dump RPN proposals for alternate training).
 
 The device side is one jitted test forward per shape bucket; the host
-side (per-class thresholding/NMS, detection accumulation) stays numpy
-exactly like the reference — eval is offline and host NMS on ≤300 boxes
-is microseconds.
+side (per-class thresholding/NMS, detection accumulation) stays on the
+host exactly like the reference, with the NMS inner loop in native C
+(``native/hostops.c`` — the reference's ``cpu_nms.pyx`` role).
 """
 
 from __future__ import annotations
@@ -23,7 +23,7 @@ import numpy as np
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
-from mx_rcnn_tpu.ops.nms import nms_numpy
+from mx_rcnn_tpu.native.hostops import nms_host
 
 logger = logging.getLogger(__name__)
 
@@ -125,7 +125,7 @@ def pred_eval(
             cls_dets = np.hstack(
                 [boxes[keep, j * 4 : (j + 1) * 4], scores[keep, j : j + 1]]
             ).astype(np.float32)
-            keep_nms = nms_numpy(cls_dets, te.NMS)
+            keep_nms = nms_host(cls_dets, te.NMS)
             all_boxes[j][i] = cls_dets[keep_nms]
             if with_masks:
                 mask_probs[j] = det["mask_probs"][keep][keep_nms, :, :, j]
@@ -209,16 +209,17 @@ def generate_proposals(
     Reference: ``rcnn/core/tester.py :: generate_proposals`` (+ the
     ``.pkl`` dump consumed by ``load_proposal_roidb``).
     """
-    proposals = []
-    for rec, batch in loader:
+    proposals: List[Optional[np.ndarray]] = [None] * len(loader)
+    for idxs, recs, batch in loader.iter_batched():
         out = predictor.predict(batch)
-        rois = out["rois"][0]
-        valid = out["roi_valid"][0].astype(bool)
-        scale = float(batch["im_info"][0][2])
-        boxes = rois[valid] / scale
-        scores = np.asarray(out["roi_scores"][0])[valid]
-        dets = np.hstack([boxes, scores[:, None]]).astype(np.float32)
-        proposals.append(dets)
+        for k, i in enumerate(idxs):
+            rois = out["rois"][k]
+            valid = out["roi_valid"][k].astype(bool)
+            scale = float(batch["im_info"][k][2])
+            boxes = rois[valid] / scale
+            scores = np.asarray(out["roi_scores"][k])[valid]
+            dets = np.hstack([boxes, scores[:, None]]).astype(np.float32)
+            proposals[i] = dets
     if dump_path:
         with open(dump_path, "wb") as f:
             pickle.dump(proposals, f, pickle.HIGHEST_PROTOCOL)

@@ -37,7 +37,11 @@ import numpy as np
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.models.heads import MaskHead, RCNNHead
 from mx_rcnn_tpu.models.layers import conv
-from mx_rcnn_tpu.models.resnet import ResNetBackbone
+from mx_rcnn_tpu.models.resnet import (
+    RESNET_BLOCK_ORDER,
+    ResNetBackbone,
+    frozen_prefix_len,
+)
 from mx_rcnn_tpu.models.rpn import RPNHead
 from mx_rcnn_tpu.ops.anchors import shifted_anchors
 from mx_rcnn_tpu.ops.losses import (
@@ -125,7 +129,12 @@ class FPNFasterRCNN(nn.Module):
         cfg = self.cfg
         dtype = _dtype_of(cfg)
         self.backbone = ResNetBackbone(
-            depth=cfg.network.depth, dtype=dtype, return_pyramid=True
+            depth=cfg.network.depth,
+            dtype=dtype,
+            return_pyramid=True,
+            frozen_prefix=frozen_prefix_len(
+                cfg.network.FIXED_PARAMS, RESNET_BLOCK_ORDER, requires=("bn",)
+            ),
         )
         self.neck = FPNNeck(channels=cfg.network.FPN_CHANNELS, dtype=dtype)
         # one RPN head shared across levels (FPN paper); 3 anchors/cell

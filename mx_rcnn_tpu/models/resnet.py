@@ -19,11 +19,43 @@ from __future__ import annotations
 from typing import Any, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from mx_rcnn_tpu.models.layers import FrozenBatchNorm, conv
 
 _BLOCKS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+# leading-block order used for the frozen-prefix stop_gradient boundary;
+# must match the module names in ResNetBackbone.__call__
+RESNET_BLOCK_ORDER = ("conv0", "stage1", "stage2", "stage3")
+
+
+def frozen_prefix_len(
+    fixed_params: Sequence[str],
+    order: Sequence[str],
+    requires: Sequence[str] = (),
+) -> int:
+    """Length of the contiguous leading run of ``order`` whose names are
+    frozen under FIXED_PARAMS prefix semantics (core.train.is_frozen_path).
+    The backbone stops gradients at that boundary: parameters below it
+    get zero updates from the optimizer mask anyway, so skipping their
+    backward pass is an exact-semantics compute saving (~25% of the
+    ResNet-101 backbone step at the default conv0+stage1 freeze).
+
+    ``requires``: patterns that must also be present in ``fixed_params``
+    for any stop to engage.  ResNet callers pass ("bn",): the stop lands
+    after each block's FrozenBatchNorm, so the BN affines must be frozen
+    too or the stop would silently zero their (trainable) grads."""
+    if any(req not in fixed_params for req in requires):
+        return 0
+    n = 0
+    for name in order:
+        if any(name == pat or name.startswith(pat) for pat in fixed_params):
+            n += 1
+        else:
+            break
+    return n
 
 
 class Bottleneck(nn.Module):
@@ -79,18 +111,27 @@ class ResNetBackbone(nn.Module):
     depth: int = 101
     dtype: Any = jnp.float32
     return_pyramid: bool = False
+    # number of leading blocks [conv0, stage1, stage2, stage3] whose output
+    # gradient is stopped (their params are frozen via the FIXED_PARAMS
+    # optimizer mask; the stop makes XLA skip their backward entirely)
+    frozen_prefix: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray):
         blocks = _BLOCKS[self.depth]
+
+        def boundary(x, idx):
+            return jax.lax.stop_gradient(x) if self.frozen_prefix == idx else x
+
         x = x.astype(self.dtype)
         x = conv(64, 7, 2, self.dtype, name="conv0")(x)
         x = FrozenBatchNorm(dtype=self.dtype, name="bn0")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
-        c2 = ResNetStage(64, blocks[0], 1, self.dtype, name="stage1")(x)
-        c3 = ResNetStage(128, blocks[1], 2, self.dtype, name="stage2")(c2)
-        c4 = ResNetStage(256, blocks[2], 2, self.dtype, name="stage3")(c3)
+        x = boundary(x, 1)
+        c2 = boundary(ResNetStage(64, blocks[0], 1, self.dtype, name="stage1")(x), 2)
+        c3 = boundary(ResNetStage(128, blocks[1], 2, self.dtype, name="stage2")(c2), 3)
+        c4 = boundary(ResNetStage(256, blocks[2], 2, self.dtype, name="stage3")(c3), 4)
         if not self.return_pyramid:
             return c4
         c5 = ResNetStage(512, blocks[3], 2, self.dtype, name="stage4")(c4)

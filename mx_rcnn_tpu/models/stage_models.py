@@ -23,9 +23,14 @@ import jax.numpy as jnp
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.models.heads import RCNNHead
-from mx_rcnn_tpu.models.resnet import ResNetBackbone, ResNetTopHead
+from mx_rcnn_tpu.models.resnet import (
+    RESNET_BLOCK_ORDER,
+    ResNetBackbone,
+    ResNetTopHead,
+    frozen_prefix_len,
+)
 from mx_rcnn_tpu.models.rpn import RPNHead
-from mx_rcnn_tpu.models.vgg import VGGBackbone, VGGTopHead
+from mx_rcnn_tpu.models.vgg import VGG_BLOCK_ORDER, VGGBackbone, VGGTopHead
 from mx_rcnn_tpu.ops.anchors import shifted_anchors
 from mx_rcnn_tpu.ops.losses import accuracy, softmax_cross_entropy, weighted_smooth_l1
 from mx_rcnn_tpu.ops.proposal import propose
@@ -37,14 +42,26 @@ def _dtype_of(cfg: Config):
     return jnp.bfloat16 if cfg.network.COMPUTE_DTYPE == "bfloat16" else jnp.float32
 
 
-def build_backbone(cfg: Config, dtype) -> Tuple[nn.Module, nn.Module]:
+def build_backbone(
+    cfg: Config, dtype, fixed_params: Optional[Tuple[str, ...]] = None
+) -> Tuple[nn.Module, nn.Module]:
     """(backbone, top_head) for the configured network — shared across
     FasterRCNN / RPNOnly / FastRCNN so param trees align for
-    ``combine_model``."""
+    ``combine_model``.
+
+    The backbone stops gradients at the contiguous-prefix boundary of
+    the freeze set: those params get zero updates from the optimizer
+    mask either way, so XLA skipping their backward pass is free speed.
+    ``fixed_params`` must name the set the optimizer actually freezes
+    (stage-2 alternate training passes FIXED_PARAMS_SHARED); defaults to
+    ``cfg.network.FIXED_PARAMS``."""
+    fixed = cfg.network.FIXED_PARAMS if fixed_params is None else fixed_params
     if cfg.network.name == "vgg":
-        return VGGBackbone(dtype=dtype), VGGTopHead(dtype=dtype)
+        n = frozen_prefix_len(fixed, VGG_BLOCK_ORDER)
+        return VGGBackbone(dtype=dtype, frozen_prefix=n), VGGTopHead(dtype=dtype)
+    n = frozen_prefix_len(fixed, RESNET_BLOCK_ORDER, requires=("bn",))
     return (
-        ResNetBackbone(depth=cfg.network.depth, dtype=dtype),
+        ResNetBackbone(depth=cfg.network.depth, dtype=dtype, frozen_prefix=n),
         ResNetTopHead(depth=cfg.network.depth, dtype=dtype),
     )
 
@@ -54,14 +71,20 @@ class RPNOnly(nn.Module):
 
     Param tree: {backbone, rpn} — name-compatible with FasterRCNN so
     stage checkpoints transfer by subtree copy.
+
+    ``fixed_params``: the freeze set the optimizer will use, when it
+    differs from cfg.network.FIXED_PARAMS (stage-4 alternate training
+    freezes FIXED_PARAMS_SHARED) — keeps the backbone's backward-skip
+    boundary aligned with the actual freeze.
     """
 
     cfg: Config
+    fixed_params: Optional[Tuple[str, ...]] = None
 
     def setup(self):
         cfg = self.cfg
         dtype = _dtype_of(cfg)
-        self.backbone, _ = build_backbone(cfg, dtype)
+        self.backbone, _ = build_backbone(cfg, dtype, self.fixed_params)
         self.rpn = RPNHead(
             num_anchors=cfg.network.NUM_ANCHORS, channels=512, dtype=dtype
         )
@@ -146,15 +169,16 @@ class FastRCNN(nn.Module):
     an RPN via ``generate_proposals``) instead of an in-graph RPN.
 
     Param tree: {backbone, top_head, rcnn} — name-compatible with
-    FasterRCNN.
+    FasterRCNN.  ``fixed_params`` as on :class:`RPNOnly`.
     """
 
     cfg: Config
+    fixed_params: Optional[Tuple[str, ...]] = None
 
     def setup(self):
         cfg = self.cfg
         dtype = _dtype_of(cfg)
-        self.backbone, self.top_head = build_backbone(cfg, dtype)
+        self.backbone, self.top_head = build_backbone(cfg, dtype, self.fixed_params)
         self.rcnn = RCNNHead(num_classes=cfg.dataset.NUM_CLASSES, dtype=dtype)
 
     def _roi_features(self, feat: jnp.ndarray, rois: jnp.ndarray) -> jnp.ndarray:

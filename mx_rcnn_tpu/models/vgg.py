@@ -11,12 +11,17 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from mx_rcnn_tpu.models.layers import conv
 
 # (number of convs, channels) per block; pool after each of the first 4
 _VGG16 = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+
+# leading-block order for the frozen-prefix stop_gradient boundary; block
+# b's convs are named conv{b}_{i} in VGGBackbone.__call__
+VGG_BLOCK_ORDER = ("conv1", "conv2", "conv3", "conv4", "conv5")
 
 
 class VGGBackbone(nn.Module):
@@ -27,6 +32,10 @@ class VGGBackbone(nn.Module):
     """
 
     dtype: Any = jnp.float32
+    # number of leading conv blocks whose output gradient is stopped (the
+    # FIXED_PARAMS optimizer mask freezes their params; the stop lets XLA
+    # skip their backward pass — see resnet.frozen_prefix_len)
+    frozen_prefix: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -39,6 +48,8 @@ class VGGBackbone(nn.Module):
                 x = nn.relu(x)
             if b < 5:
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            if b == self.frozen_prefix:
+                x = jax.lax.stop_gradient(x)
         return x
 
 

@@ -1,0 +1,49 @@
+"""Shared build-and-load helper for the native C libraries.
+
+One compile-cache-dlopen path for ``rlelib.c`` and ``hostops.c``: the
+cache lives under a 0700 per-user directory (never a shared
+world-writable path another user could pre-seed), and the build writes
+to a unique temp name + atomic rename so concurrent processes never
+dlopen a half-written file.  Returns None on any failure — callers keep
+a pure-numpy fallback so nothing hard-fails without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+def build_and_load(src_path: str, so_name: str) -> Optional[ctypes.CDLL]:
+    """Compile ``src_path`` → ``~/.cache/mx_rcnn_tpu/<so_name>`` (rebuilt
+    when the source is newer) and dlopen it."""
+    cache_dir = os.environ.get(
+        "XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")
+    )
+    cache_dir = os.path.join(cache_dir, "mx_rcnn_tpu")
+    so_path = os.path.join(cache_dir, so_name)
+    try:
+        if (not os.path.exists(so_path)) or (
+            os.path.getmtime(so_path) < os.path.getmtime(src_path)
+        ):
+            os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+            cc = os.environ.get("CC", "cc")
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
+            os.close(fd)
+            subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", src_path, "-o", tmp],
+                check=True, capture_output=True,
+            )
+            os.replace(tmp, so_path)
+        return ctypes.CDLL(so_path)
+    except Exception as e:  # no compiler / load failure → numpy fallback
+        logger.warning(
+            "native %s unavailable (%s); using numpy fallback", so_name, e
+        )
+        return None

@@ -1,0 +1,105 @@
+"""Host-side detection ops: ctypes binding of hostops.c + numpy fallback.
+
+Reference: ``rcnn/cython/cpu_nms.pyx`` and ``rcnn/cython/bbox.pyx`` — the
+reference compiled these host inner loops to Cython extensions because
+the pure-python versions dominated eval time at COCO scale (5k images ×
+80 classes of per-class NMS).  Same stance here with plain C (no
+pybind11 in this image; ctypes like ``native/rle.py``), and a numpy
+fallback so nothing hard-fails without a compiler.
+
+The TPU in-graph NMS (``ops/nms.py``, ``ops/pallas/nms.py``) is the
+training/inference path; these functions only serve code that already
+holds numpy on the host (eval, demo, dataset utilities).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from mx_rcnn_tpu.native._build import build_and_load
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "hostops.c")
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    lib = build_and_load(_SRC, "hostops.so")
+    if lib is None:
+        return None
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.cpu_nms.restype = ctypes.c_int
+    lib.cpu_nms.argtypes = [f32p, ctypes.c_int, ctypes.c_float, i32p]
+    lib.bbox_overlaps.restype = None
+    lib.bbox_overlaps.argtypes = [f32p, ctypes.c_int, f32p, ctypes.c_int, f32p]
+    return lib
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if not _TRIED:
+        _LIB = _build_and_load()
+        _TRIED = True
+    return _LIB
+
+
+def nms_host(dets: np.ndarray, thresh: float) -> List[int]:
+    """Greedy NMS on (N, 5) [x1, y1, x2, y2, score] → kept indices in
+    score-descending order.  Exact twin of ``ops.nms.nms_numpy``
+    (including its reversed-argsort tie order), ~50× faster at COCO
+    per-class sizes through the C path."""
+    n = int(dets.shape[0])
+    if n == 0:
+        return []
+    lib = _lib()
+    if lib is None:
+        from mx_rcnn_tpu.ops.nms import nms_numpy
+
+        return nms_numpy(dets, thresh)
+    dets32 = np.ascontiguousarray(dets[:, :5], dtype=np.float32)
+    keep = np.empty(n, np.int32)
+    n_keep = lib.cpu_nms(dets32, n, float(thresh), keep)
+    if n_keep < 0:  # allocation failure inside the C path
+        from mx_rcnn_tpu.ops.nms import nms_numpy
+
+        return nms_numpy(dets, thresh)
+    return keep[:n_keep].tolist()
+
+
+def bbox_overlaps_host(boxes: np.ndarray, query: np.ndarray) -> np.ndarray:
+    """(N, 4) × (K, 4) → (N, K) IoU matrix (inclusive-pixel convention),
+    C-accelerated with a numpy fallback."""
+    n, k = int(boxes.shape[0]), int(query.shape[0])
+    out = np.zeros((n, k), np.float32)
+    if n == 0 or k == 0:
+        return out
+    lib = _lib()
+    if lib is None:
+        bx = boxes.astype(np.float32)
+        qx = query.astype(np.float32)
+        ba = (bx[:, 2] - bx[:, 0] + 1) * (bx[:, 3] - bx[:, 1] + 1)
+        qa = (qx[:, 2] - qx[:, 0] + 1) * (qx[:, 3] - qx[:, 1] + 1)
+        iw = np.minimum(bx[:, None, 2], qx[None, :, 2]) - np.maximum(
+            bx[:, None, 0], qx[None, :, 0]
+        ) + 1
+        ih = np.minimum(bx[:, None, 3], qx[None, :, 3]) - np.maximum(
+            bx[:, None, 1], qx[None, :, 1]
+        ) + 1
+        inter = np.maximum(iw, 0) * np.maximum(ih, 0)
+        union = ba[:, None] + qa[None, :] - inter
+        return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0).astype(
+            np.float32
+        )
+    b32 = np.ascontiguousarray(boxes[:, :4], dtype=np.float32)
+    q32 = np.ascontiguousarray(query[:, :4], dtype=np.float32)
+    lib.bbox_overlaps(b32, n, q32, k, out)
+    return out

@@ -16,8 +16,6 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
-import tempfile
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -31,33 +29,10 @@ _TRIED = False
 
 
 def _build_and_load() -> Optional[ctypes.CDLL]:
-    """Compile rlelib.c → a per-user cached .so and dlopen it.
+    from mx_rcnn_tpu.native._build import build_and_load
 
-    The cache lives under a 0700 per-user directory (never a shared
-    world-writable path another user could pre-seed), and the build
-    writes to a unique temp name + atomic rename so concurrent processes
-    never dlopen a half-written file."""
-    cache_dir = os.environ.get(
-        "XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")
-    )
-    cache_dir = os.path.join(cache_dir, "mx_rcnn_tpu")
-    so_path = os.path.join(cache_dir, "rlelib.so")
-    try:
-        if (not os.path.exists(so_path)) or (
-            os.path.getmtime(so_path) < os.path.getmtime(_SRC)
-        ):
-            os.makedirs(cache_dir, mode=0o700, exist_ok=True)
-            cc = os.environ.get("CC", "cc")
-            fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
-            os.close(fd)
-            subprocess.run(
-                [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
-                check=True, capture_output=True,
-            )
-            os.replace(tmp, so_path)
-        lib = ctypes.CDLL(so_path)
-    except Exception as e:  # no compiler / load failure → numpy fallback
-        logger.warning("native rlelib unavailable (%s); using numpy fallback", e)
+    lib = build_and_load(_SRC, "rlelib.so")
+    if lib is None:
         return None
     u32p = np.ctypeslib.ndpointer(np.uint32)
     i32p = np.ctypeslib.ndpointer(np.int32)

@@ -41,6 +41,8 @@ def nms_mask(
     scores: jnp.ndarray,
     thresh: float,
     valid: jnp.ndarray | None = None,
+    sorted_input: bool = False,
+    max_keep: int = 0,
 ) -> jnp.ndarray:
     """Greedy NMS → bool keep mask aligned with the *input* order.
 
@@ -48,18 +50,36 @@ def nms_mask(
     kernels: walk boxes in descending score; a box survives iff no
     higher-scoring *surviving* box overlaps it above ``thresh``.
     Invalid (padding) entries never survive and never suppress.
+
+    ``sorted_input``: promise that ``boxes``/``valid`` are already in
+    descending-score order (e.g. straight out of ``lax.top_k``) — skips
+    an argsort + scatter round-trip.
+
+    ``max_keep``: with ``sorted_input``, stop the sweep once that many
+    survivors exist — exact iff the caller keeps only the top
+    ``max_keep`` survivors by score (``nms`` does).
     """
     n = boxes.shape[0]
     if valid is None:
         valid = jnp.ones((n,), dtype=bool)
     if _use_pallas():
-        from mx_rcnn_tpu.ops.pallas.nms import nms_mask_pallas
+        from mx_rcnn_tpu.ops.pallas.nms import (
+            nms_mask_pallas,
+            nms_mask_sorted_pallas,
+        )
 
+        if sorted_input:
+            return nms_mask_sorted_pallas(
+                boxes, valid, thresh, max_keep=max_keep
+            )
         return nms_mask_pallas(boxes, scores, thresh, valid)
-    scores = jnp.where(valid, scores, _NEG_INF)
-    order = jnp.argsort(-scores)
-    b = boxes[order].astype(jnp.float32)
-    v = valid[order]
+    if sorted_input:
+        b, v, order = boxes.astype(jnp.float32), valid, None
+    else:
+        scores = jnp.where(valid, scores, _NEG_INF)
+        order = jnp.argsort(-scores)
+        b = boxes[order].astype(jnp.float32)
+        v = valid[order]
 
     def body(i, alive):
         row = _iou_row(b[i], b)
@@ -67,6 +87,8 @@ def nms_mask(
         return alive & ~suppress
 
     alive = jax.lax.fori_loop(0, n, body, v)
+    if order is None:
+        return alive
     # scatter back to input order
     keep = jnp.zeros((n,), dtype=bool).at[order].set(alive)
     return keep
@@ -78,6 +100,7 @@ def nms(
     thresh: float,
     max_out: int,
     valid: jnp.ndarray | None = None,
+    sorted_input: bool = False,
 ):
     """NMS + select top ``max_out`` survivors by score (fixed shape).
 
@@ -87,7 +110,12 @@ def nms(
     ``gpu_nms`` — the pad-to-``post_nms_top_n`` discipline the reference
     already applied in ``rcnn/symbol/proposal.py`` generalized.
     """
-    keep = nms_mask(boxes, scores, thresh, valid)
+    # with a sorted input the kernel may stop once max_out survivors
+    # exist — the top_k below only ever reads that prefix
+    keep = nms_mask(
+        boxes, scores, thresh, valid, sorted_input=sorted_input,
+        max_keep=max_out if sorted_input else 0,
+    )
     masked = jnp.where(keep, scores, _NEG_INF)
     if masked.shape[0] < max_out:  # static: pad so top_k(k) is well-formed
         pad = max_out - masked.shape[0]
@@ -130,7 +158,10 @@ def nms_numpy(dets: np.ndarray, thresh: float) -> list:
         return []
     x1, y1, x2, y2, scores = dets[:, 0], dets[:, 1], dets[:, 2], dets[:, 3], dets[:, 4]
     areas = (x2 - x1 + 1) * (y2 - y1 + 1)
-    order = scores.argsort()[::-1]
+    # stable sort pins the equal-score visit order (descending index
+    # after the reversal) so the native C path (hostops.c) can match it
+    # exactly; numpy's default introsort leaves tie order unspecified
+    order = scores.argsort(kind="stable")[::-1]
     keep = []
     while order.size > 0:
         i = order[0]

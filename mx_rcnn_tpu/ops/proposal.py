@@ -58,7 +58,9 @@ def propose(
     top_boxes = boxes[idx]
     top_valid = top_scores > _NEG_INF / 2
 
+    # top_k output is descending-score: the NMS can skip its own sort
     out_boxes, out_scores, out_valid = nms(
-        top_boxes, top_scores, nms_thresh, post_nms_top_n, top_valid
+        top_boxes, top_scores, nms_thresh, post_nms_top_n, top_valid,
+        sorted_input=True,
     )
     return Proposals(out_boxes, out_scores, out_valid)

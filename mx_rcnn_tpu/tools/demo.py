@@ -21,7 +21,7 @@ from mx_rcnn_tpu.core.tester import Predictor, im_detect
 from mx_rcnn_tpu.data.image import load_image
 from mx_rcnn_tpu.data.loader import make_batch
 from mx_rcnn_tpu.models import build_model
-from mx_rcnn_tpu.ops.nms import nms_numpy
+from mx_rcnn_tpu.native.hostops import nms_host
 from mx_rcnn_tpu.utils.visualize import draw_detections, save_image
 
 logger = logging.getLogger(__name__)
@@ -64,7 +64,7 @@ def demo_net(
         cls_dets = np.hstack(
             [boxes[keep, j * 4 : (j + 1) * 4], scores[keep, j : j + 1]]
         ).astype(np.float32)
-        cls_dets = cls_dets[nms_numpy(cls_dets, cfg.TEST.NMS)]
+        cls_dets = cls_dets[nms_host(cls_dets, cfg.TEST.NMS)]
         if (cls_dets[:, 4] >= vis_thresh).any():
             dets_by_class[class_names[j]] = cls_dets
     return dets_by_class

@@ -30,6 +30,9 @@ def reeval(imdb, detections_path: str):
 
 
 def main():
+    from mx_rcnn_tpu.utils.platform import cli_bootstrap
+
+    cli_bootstrap()
     p = argparse.ArgumentParser(description="Re-score saved detections")
     p.add_argument("--network", default="resnet",
                    choices=["vgg", "resnet", "resnet50", "resnet152"])

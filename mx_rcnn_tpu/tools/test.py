@@ -17,7 +17,11 @@ import logging
 import jax
 
 from mx_rcnn_tpu.config import generate_config
-from mx_rcnn_tpu.core.checkpoint import latest_epoch, load_checkpoint
+from mx_rcnn_tpu.core.checkpoint import (
+    latest_checkpoint,
+    latest_epoch,
+    load_checkpoint,
+)
 from mx_rcnn_tpu.core.tester import Predictor, pred_eval
 from mx_rcnn_tpu.core.train import create_train_state, make_optimizer
 from mx_rcnn_tpu.data.loader import TestLoader
@@ -89,14 +93,27 @@ def test_rcnn(args):
             np.array([[h, w, 1.0]], np.float32),
             train=False,
         )["params"]
-        epoch = args.epoch if args.epoch is not None else latest_epoch(args.prefix)
-        if epoch is not None:
+        if args.epoch is not None:
+            found = (args.epoch, 0)
+        else:
+            # prefer epoch-boundary checkpoints, but fall back to a
+            # mid-epoch step_EEEE_SSSSSS preemption dump so a run
+            # preempted before its first epoch boundary does not get
+            # silently evaluated at random init
+            epoch = latest_epoch(args.prefix)
+            found = (epoch, 0) if epoch is not None else latest_checkpoint(args.prefix)
+        if found is not None:
+            epoch, batch_in_epoch = found
             tx = make_optimizer(cfg, lambda s: 0.0)
             state = load_checkpoint(
-                args.prefix, epoch, create_train_state(params, tx)
+                args.prefix, epoch, create_train_state(params, tx),
+                batch_in_epoch=batch_in_epoch,
             )
             params = state.params
-            logger.info("loaded checkpoint epoch %d", epoch)
+            logger.info(
+                "loaded checkpoint epoch %d%s", epoch,
+                f" batch {batch_in_epoch}" if batch_in_epoch else "",
+            )
         else:
             logger.warning(
                 "no checkpoint found at %s — evaluating random init", args.prefix

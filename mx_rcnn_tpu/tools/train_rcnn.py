@@ -43,8 +43,8 @@ def train_rcnn(
         cfg = cfg.replace(
             TRAIN=dataclasses.replace(cfg.TRAIN, BBOX_MEANS=means, BBOX_STDS=stds)
         )
-    model = FastRCNN(cfg)
     fixed = cfg.network.FIXED_PARAMS_SHARED if frozen_shared else None
+    model = FastRCNN(cfg, fixed_params=fixed)
     params = fit(
         model, cfg, proposal_roidb,
         epochs=epochs, seed=seed, init_donor=init_donor,

@@ -35,8 +35,8 @@ def train_rpn(
 
     ``frozen_shared`` freezes FIXED_PARAMS_SHARED (stage-4 semantics:
     shared convs pinned to the donor's weights)."""
-    model = RPNOnly(cfg)
     fixed = cfg.network.FIXED_PARAMS_SHARED if frozen_shared else None
+    model = RPNOnly(cfg, fixed_params=fixed)
     return fit(
         model, cfg, roidb,
         epochs=epochs, seed=seed, init_donor=init_donor,

@@ -26,20 +26,16 @@ from mx_rcnn_tpu.config import Config
 def np_overlaps(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     """(N, 4) × (K, 4) → (N, K) IoU, +1 width convention.
 
-    Host-numpy twin of ``ops.boxes.bbox_overlaps`` (tested for agreement
-    in tests/test_geometry.py) — host loops over a roidb shouldn't pay a
-    jnp dispatch per record.
-    """
-    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
-    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
-    iw = np.minimum(a[:, None, 2], b[None, :, 2]) - np.maximum(
-        a[:, None, 0], b[None, :, 0]
-    ) + 1
-    ih = np.minimum(a[:, None, 3], b[None, :, 3]) - np.maximum(
-        a[:, None, 1], b[None, :, 1]
-    ) + 1
-    inter = np.clip(iw, 0, None) * np.clip(ih, 0, None)
-    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-12)
+    Host twin of ``ops.boxes.bbox_overlaps`` (tested for agreement in
+    tests/test_geometry.py) — host loops over a roidb shouldn't pay a
+    jnp dispatch per record.  Backed by the native C kernel
+    (``native/hostops.c``, the reference's ``bbox.pyx`` role) with a
+    numpy fallback inside."""
+    from mx_rcnn_tpu.native.hostops import bbox_overlaps_host
+
+    return bbox_overlaps_host(
+        np.asarray(a, np.float32), np.asarray(b, np.float32)
+    )
 
 
 def np_transform(ex: np.ndarray, gt: np.ndarray) -> np.ndarray:

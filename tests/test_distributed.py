@@ -90,7 +90,13 @@ print(f"proc {proc_id}: loss={loss:.5f}", flush=True)
 
 
 def test_two_process_dp_step(tmp_path):
-    port = 12355
+    # pick a free port: a hardcoded one collides with stale listeners or
+    # parallel CI jobs on the same host
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
     code = _WORKER.replace("{port}", str(port))
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)

@@ -117,6 +117,55 @@ class TestTrainForward:
         nz = sum(float(jnp.abs(v).sum()) > 0 for v in flat.values())
         assert nz > len(flat) * 0.4
 
+    def test_frozen_prefix_stop_gradient_exact(self, model_and_params):
+        """The backbone's frozen-prefix stop_gradient is a pure compute
+        saving: trainable-param grads are bit-identical to the unstopped
+        graph, and the frozen subtrees' grads become exactly zero."""
+        cfg, model, params = model_and_params
+        cfg_nostop = cfg.replace(
+            network=dataclasses.replace(cfg.network, FIXED_PARAMS=())
+        )
+        model_nostop = FasterRCNN(cfg_nostop)
+        batch = tiny_batch(np.random.RandomState(1))
+
+        def loss_fn(m):
+            def f(p):
+                loss, _ = m.apply(
+                    {"params": p},
+                    batch["images"],
+                    batch["im_info"],
+                    batch["gt_boxes"],
+                    batch["gt_valid"],
+                    train=True,
+                    rngs={"sampling": jax.random.key(3)},
+                )
+                return loss
+
+            return f
+
+        g_stop = jax.grad(loss_fn(model))(params)
+        g_full = jax.grad(loss_fn(model_nostop))(params)
+        import flax
+
+        f_stop = flax.traverse_util.flatten_dict(g_stop)
+        f_full = flax.traverse_util.flatten_dict(g_full)
+        frozen_roots = ("conv0", "bn0", "stage1")
+        saw_frozen = saw_cut = 0
+        for k in f_stop:
+            sub = k[1] if k[0] == "backbone" else None
+            if sub is not None and any(sub.startswith(r) for r in frozen_roots):
+                saw_frozen += 1
+                assert float(jnp.abs(f_stop[k]).sum()) == 0.0, k
+                if float(jnp.abs(f_full[k]).sum()) > 0:
+                    saw_cut += 1
+            else:
+                np.testing.assert_array_equal(
+                    np.asarray(f_stop[k]), np.asarray(f_full[k]), err_msg=str(k)
+                )
+        assert saw_frozen > 0
+        # the unstopped graph really was computing nonzero grads there
+        assert saw_cut > 0
+
 
 class TestTestForward:
     def test_shapes_and_probs(self, model_and_params):

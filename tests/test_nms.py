@@ -25,6 +25,25 @@ class TestNmsMask:
         expected = set(nms_numpy(dets, thresh))
         assert set(np.where(keep)[0]) == expected
 
+    @pytest.mark.parametrize("n", [17, 200])
+    def test_sorted_input_fast_path_matches(self, rng, n):
+        # the propose() path feeds top_k output with sorted_input=True;
+        # it must agree with the general path on pre-sorted data
+        boxes, scores = random_dets(rng, n)
+        order = np.argsort(-scores)
+        boxes, scores = boxes[order], scores[order]
+        valid = jnp.arange(n) < (n - 3)
+        a = np.asarray(
+            nms_mask(jnp.array(boxes), jnp.array(scores), 0.5, valid)
+        )
+        b = np.asarray(
+            nms_mask(
+                jnp.array(boxes), jnp.array(scores), 0.5, valid,
+                sorted_input=True,
+            )
+        )
+        assert (a == b).all()
+
     def test_invalid_never_suppresses(self, rng):
         # an invalid high-score box overlapping a valid one must not kill it
         boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], dtype=np.float32)
