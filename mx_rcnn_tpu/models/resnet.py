@@ -46,12 +46,18 @@ def frozen_prefix_len(
     ``requires``: patterns that must also be present in ``fixed_params``
     for any stop to engage.  ResNet callers pass ("bn",): the stop lands
     after each block's FrozenBatchNorm, so the BN affines must be frozen
-    too or the stop would silently zero their (trainable) grads."""
+    too or the stop would silently zero their (trainable) grads.
+
+    Matching delegates to ``core.train.is_frozen_path`` — the optimizer
+    mask's own rule — so the stop boundary can never drift from what the
+    optimizer actually freezes."""
+    from mx_rcnn_tpu.core.train import is_frozen_path
+
     if any(req not in fixed_params for req in requires):
         return 0
     n = 0
     for name in order:
-        if any(name == pat or name.startswith(pat) for pat in fixed_params):
+        if is_frozen_path((name,), fixed_params):
             n += 1
         else:
             break

@@ -178,9 +178,14 @@ def nms_mask_sorted_pallas(
     """
     n = boxes.shape[0]
     n_pad = ((n + BLOCK - 1) // BLOCK) * BLOCK
-    # cross-block slab lane width; N is padded to a multiple of it (≤ 17%
-    # over-pad at the 2048 cap, ~2% at the flagship 12000)
-    chunk = min(2048, n_pad)
+    # cross-block slab lane width: the largest candidate whose padding
+    # waste stays ≤ 12.5% of the block-padded N (a fixed 2048 would pad
+    # the default test shape 6016 → 8192, +36% slab area; 1536 pads it
+    # to 6144, +2%).  BLOCK always divides n_pad, so the loop terminates.
+    for chunk in (2048, 1536, 1024, 512, 256, BLOCK):
+        padded = ((n_pad + chunk - 1) // chunk) * chunk
+        if chunk <= n_pad and padded - n_pad <= n_pad // 8:
+            break
     n_pad = ((n_pad + chunk - 1) // chunk) * chunk
     coords = jnp.zeros((8, n_pad), jnp.float32)
     bt = boxes.astype(jnp.float32).T                               # (4, N)

@@ -84,40 +84,60 @@ def _fwd_kernel(rois_ref, feat_ref, out_ref, *, pooled, s, scale):
     my, mx = _matrices_for_roi(rois_ref, b, r, hf, wf, pooled, s, scale)
     feat = feat_ref[0]                                               # (H, W, CB)
     # rows: (PH, W, CB) = contract H;   out: (PH, PW, CB) = contract W
-    # HIGHEST precision: these matmuls are <0.1% of the step's FLOPs but
-    # default MXU bf16 rounding costs ~1e-3 relative error vs the gather
-    # reference
-    rows = jax.lax.dot_general(
-        my, feat.astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
-    out = jax.lax.dot_general(
-        mx, rows, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )                                                                # (PW, PH, CB)
+    # Precision follows the graph's dtype: a bf16 training graph gets
+    # single-pass bf16 dots with f32 accumulation (the same contract as
+    # every conv around it); an f32 graph (eval parity) keeps 6-pass
+    # HIGHEST — there the kernel must match the gather reference to
+    # ~1e-5, not ~1e-3.
+    if feat.dtype == jnp.bfloat16:
+        prec = jax.lax.Precision.DEFAULT
+        my, mx = my.astype(jnp.bfloat16), mx.astype(jnp.bfloat16)
+
+        def dot1(a, bmat, dims):
+            return jax.lax.dot_general(
+                a, bmat, dims, preferred_element_type=jnp.float32,
+                precision=prec,
+            )
+
+        rows = dot1(my, feat, (((1,), (0,)), ((), ()))).astype(jnp.bfloat16)
+        out = dot1(mx, rows, (((1,), (1,)), ((), ())))
+    else:
+        rows = jax.lax.dot_general(
+            my, feat.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        out = jax.lax.dot_general(
+            mx, rows, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                                            # (PW, PH, CB)
     out_ref[0, 0] = out.transpose(1, 0, 2).astype(out_ref.dtype)
 
 
 def _bwd_kernel(rois_ref, g_ref, dfeat_ref, *, pooled, s, scale):
     """dfeat is accumulated across the roi sweep in f32 (the out_shape is
     forced f32 regardless of feat dtype — 128 sequential bf16 adds would
-    swallow small per-roi contributions); cast back outside the kernel."""
+    swallow small per-roi contributions); cast back outside the kernel.
+
+    Two deliberate asymmetries vs the forward kernel: the accumulator is
+    laid out TRANSPOSED, (W, H, CB) — the second dot emits that order,
+    and one XLA transpose of the final (B, W, H, C) outside the kernel
+    replaces B·R·(C/CB) in-kernel transposes (measured 35 ms → a few ms
+    on the flagship step) — and the dots run at default MXU precision:
+    the incoming cotangent is bf16 in the bf16 training graph, so 6-pass
+    HIGHEST f32 buys nothing the rest of the backward has."""
     b, r = pl.program_id(0), pl.program_id(2)
-    hf, wf = dfeat_ref.shape[1], dfeat_ref.shape[2]
+    wf, hf = dfeat_ref.shape[1], dfeat_ref.shape[2]
     my, mx = _matrices_for_roi(rois_ref, b, r, hf, wf, pooled, s, scale)
     g = g_ref[0, 0].astype(jnp.float32)                              # (PH, PW, CB)
-    # t: (H, PW, CB) = Myᵀ contract PH;  d: (H, W, CB) = Mxᵀ contract PW
+    # t: (H, PW, CB) = Myᵀ contract PH;  d: (W, H, CB) = Mxᵀ contract PW
     t = jax.lax.dot_general(
         my, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
     )                                                                # (H, PW, CB)
     d = jax.lax.dot_general(
         mx, t, (((0,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
     )                                                                # (W, H, CB)
-    d = d.transpose(1, 0, 2)
 
     @pl.when(r == 0)
     def _():
@@ -199,14 +219,15 @@ def _roi_align_bwd_impl(feat_shape, feat_dtype, rois, g, pooled, scale, s, inter
                 ),
             ],
             out_specs=pl.BlockSpec(
-                (1, hf, wf, cblk),
+                (1, wf, hf, cblk),
                 lambda bb, cb, rr, rois_ref: (bb, 0, 0, cb),
             ),
         ),
-        out_shape=jax.ShapeDtypeStruct((b, hf, wf, c), jnp.float32),
+        # (B, W, H, C): the kernel accumulates transposed (see docstring)
+        out_shape=jax.ShapeDtypeStruct((b, wf, hf, c), jnp.float32),
         interpret=interpret,
     )(rois.astype(jnp.float32), g)
-    return out.astype(feat_dtype)
+    return out.swapaxes(1, 2).astype(feat_dtype)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))

@@ -124,6 +124,16 @@ def ablate(args):
     print(f"roi_extract+top_head f/b    : "
           f"{timeit_chained(step_roi, p_th, it) * 1e3:8.1f} ms")
 
+    @jax.jit
+    def step_pool_only(f):
+        def loss(ff):
+            return jnp.mean(pool(ff, rois).astype(jnp.float32) ** 2)
+
+        return f - 1e-6 * jax.grad(loss)(f)
+
+    print(f"  of which roi_extract f/b  : "
+          f"{timeit_chained(step_pool_only, feat0, it) * 1e3:8.1f} ms")
+
     key = jax.random.key(0)
     scores0 = jax.random.uniform(key, (b, anchors.shape[0]))
     deltas = jax.random.normal(key, (b, anchors.shape[0], 4)) * 0.1

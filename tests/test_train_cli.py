@@ -66,3 +66,26 @@ def test_train_end2end_smoke_and_resume(tmp_path, monkeypatch):
     assert results, "eval CLI returned no metrics"
     for k, v in results.items():
         assert np.isfinite(v) and 0.0 <= v <= 1.0, (k, v)
+
+    # a run preempted before its first epoch boundary leaves only
+    # step_EEEE_SSSSSS checkpoints; the eval CLI must fall back to them
+    # instead of silently evaluating random init (ADVICE r2 #2)
+    import os
+    import shutil
+
+    step_prefix = str(tmp_path / "e2e_step_only")
+    os.makedirs(step_prefix)
+    shutil.copytree(
+        os.path.join(prefix, "epoch_0002"),
+        os.path.join(step_prefix, "step_0001_000001"),
+    )
+    shutil.copy(
+        os.path.join(prefix, "run_meta.json"),
+        os.path.join(step_prefix, "run_meta.json"),
+    )
+    results2 = test_cli.test_rcnn(test_cli.parse_args([
+        "--network", "resnet50", "--dataset", "PascalVOC",
+        "--synthetic", "8", "--prefix", step_prefix, "--max_images", "4",
+    ]))
+    for k in results:
+        np.testing.assert_allclose(results2[k], results[k], atol=1e-6)
