@@ -1,0 +1,47 @@
+# Build/test glue (reference: the repo-root Makefile that ran
+# `setup.py build_ext --inplace` over rcnn/cython + rcnn/pycocotools).
+# The TPU rebuild has no ahead-of-time extension build — Pallas kernels
+# are JIT-compiled and the C host libraries self-build into a per-user
+# cache on first import — so `make native` just forces that build and
+# `make test-kernels` is the SURVEY N4 kernel-vs-oracle harness.
+
+PY ?= python
+
+.PHONY: native test test-kernels test-fast bench integration-gate clean-native
+
+# compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
+native:
+	$(PY) -c "from mx_rcnn_tpu.native import hostops, rle; \
+	          assert hostops._lib() is not None, 'hostops build failed'; \
+	          assert rle._lib() is not None, 'rlelib build failed'; \
+	          print('native libraries built')"
+
+clean-native:
+	rm -f $${XDG_CACHE_HOME:-$$HOME/.cache}/mx_rcnn_tpu/*.so
+
+# full suite (8 virtual CPU devices via tests/conftest.py); ~2h on 1 core
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# Pallas kernels + geometry vs their oracles only (fast)
+test-kernels:
+	$(PY) -m pytest tests/test_pallas_nms.py tests/test_pallas_roi_align.py \
+	      tests/test_nms.py tests/test_geometry.py tests/test_hostops.py \
+	      tests/test_rle.py -q
+
+# quick signal: pure-host + light jit tests
+test-fast:
+	$(PY) -m pytest tests/test_geometry.py tests/test_hostops.py \
+	      tests/test_metrics.py tests/test_rle.py tests/test_datasets.py -q
+
+# flagship train throughput (real TPU); prints one JSON line
+bench:
+	$(PY) bench.py
+
+# inference throughput (host-bound on weak dev hosts; see the docstring)
+bench-eval:
+	$(PY) -m mx_rcnn_tpu.tools.bench_eval
+
+# train→eval mAP gate on synthetic data
+integration-gate:
+	$(PY) -m mx_rcnn_tpu.tools.integration_gate

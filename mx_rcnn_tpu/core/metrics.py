@@ -51,11 +51,18 @@ class MetricTracker:
 
 
 class Speedometer:
-    """imgs/sec logging every ``frequent`` batches (callback.py twin)."""
+    """imgs/sec logging every ``frequent`` batches (callback.py twin).
 
-    def __init__(self, batch_size: int, frequent: int = 20):
+    ``jsonl_path`` additionally appends one machine-readable JSON line
+    per log event — the SURVEY §5.6 structured-scalar-logging upgrade
+    (the reference had only the human-format log line)."""
+
+    def __init__(
+        self, batch_size: int, frequent: int = 20, jsonl_path: str | None = None
+    ):
         self.batch_size = batch_size
         self.frequent = frequent
+        self.jsonl_path = jsonl_path
         self._tic = time.time()
         self._last = 0
 
@@ -71,6 +78,18 @@ class Speedometer:
             speed,
             tracker.format(),
         )
+        if self.jsonl_path:
+            import json
+
+            rec = {
+                "time": time.time(),
+                "epoch": epoch,
+                "step": step,
+                "samples_per_sec": round(speed, 3),
+                **{k: round(v, 6) for k, v in tracker.get().items()},
+            }
+            with open(self.jsonl_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
         tracker.reset()
         self._tic = time.time()
         self._last = step

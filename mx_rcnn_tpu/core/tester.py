@@ -22,8 +22,8 @@ import jax
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
-from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
 from mx_rcnn_tpu.native.hostops import nms_host
+from mx_rcnn_tpu.utils.bbox_stats import np_bbox_pred, np_clip_boxes
 
 logger = logging.getLogger(__name__)
 
@@ -64,12 +64,14 @@ def im_detect(
     deltas = output["bbox_deltas"][index]
     scale = float(im_info[2])
 
-    boxes = np.asarray(bbox_pred(rois, deltas))
-    boxes = np.asarray(clip_boxes(boxes, (float(im_info[0]), float(im_info[1]))))
+    # host numpy decode, like the reference's nonlinear_pred: a jnp call
+    # here would pay a device dispatch per image during the eval loop
+    boxes = np_bbox_pred(np.asarray(rois), np.asarray(deltas))
+    boxes = np_clip_boxes(boxes, (float(im_info[0]), float(im_info[1])))
     boxes = boxes / scale
     # final clip to the original image extent
     h, w = orig_hw
-    boxes = np.asarray(clip_boxes(boxes, (float(h), float(w))))
+    boxes = np_clip_boxes(boxes, (float(h), float(w)))
     det = {"scores": scores[valid], "boxes": boxes[valid]}
     if "mask_logits" in output:  # Mask R-CNN branch: per-roi (S, S, K)
         det["mask_probs"] = 1.0 / (

@@ -117,6 +117,36 @@ def _orientation_bucket(rec: Dict, buckets) -> Tuple[int, int]:
     return tuple(buckets[0])
 
 
+def _prefetch_iter(source, prefetch: int):
+    """Drain ``source`` through a daemon thread with a bounded queue so
+    host batch assembly overlaps the consumer's device work.  Worker
+    exceptions are re-raised in the consumer — a swallowed decode error
+    would silently truncate an epoch (or an eval sweep, corrupting mAP).
+    Shared by TrainLoader.__iter__ and TestLoader.iter_batched."""
+    if prefetch <= 0:
+        yield from source
+        return
+    q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+
+    def worker():
+        try:
+            for item in source:
+                q.put(("item", item))
+            q.put(("stop", None))
+        except BaseException as e:  # noqa: BLE001 — handed to the consumer
+            q.put(("err", e))
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        kind, payload = q.get()
+        if kind == "stop":
+            return
+        if kind == "err":
+            raise payload
+        yield payload
+
+
 class TrainLoader:
     """AnchorLoader twin: shuffled, aspect-grouped, bucket-padded batches."""
 
@@ -181,36 +211,14 @@ class TrainLoader:
         if self.row_slice is not None:
             plan = [(b, idxs[self.row_slice]) for b, idxs in plan]
         pc = self.proposal_count
-        if self.prefetch <= 0:
-            for bucket, idxs in plan:
-                yield make_batch(
-                    [self.roidb[i] for i in idxs], self.cfg, bucket,
-                    proposal_count=pc, seeds=idxs,
-                )
-            return
-
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
-        stop = object()
-
-        def worker():
-            try:
-                for bucket, idxs in plan:
-                    q.put(
-                        make_batch(
-                            [self.roidb[i] for i in idxs], self.cfg, bucket,
-                            proposal_count=pc, seeds=idxs,
-                        )
-                    )
-            finally:
-                q.put(stop)
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is stop:
-                break
-            yield item
+        source = (
+            make_batch(
+                [self.roidb[i] for i in idxs], self.cfg, bucket,
+                proposal_count=pc, seeds=idxs,
+            )
+            for bucket, idxs in plan
+        )
+        yield from _prefetch_iter(source, self.prefetch)
 
 
 class TestLoader:
@@ -249,16 +257,27 @@ class TestLoader:
             )
             yield rec, batch
 
-    def iter_batched(self):
+    def iter_batched(self, prefetch: int = 2):
+        """Yields ``(dataset_indices, records, batch)``; a background
+        thread overlaps host image assembly with the consumer's device
+        forward + fetch (same prefetcher discipline as TrainLoader —
+        host decode/resize is the eval bottleneck, not the TPU)."""
         groups: Dict[Tuple[int, int], List[int]] = {}
         for i, rec in enumerate(self.roidb):
             b = _orientation_bucket(rec, self.cfg.SHAPE_BUCKETS)
             groups.setdefault(b, []).append(i)
-        for bucket, idxs in groups.items():
-            for s in range(0, len(idxs), self.batch_size):
-                chunk = idxs[s : s + self.batch_size]
-                recs = [self.roidb[i] for i in chunk]
-                batch = make_batch(
-                    recs, self.cfg, bucket, proposal_count=self.proposal_count
-                )
-                yield chunk, recs, batch
+        plan = [
+            (bucket, idxs[s : s + self.batch_size])
+            for bucket, idxs in groups.items()
+            for s in range(0, len(idxs), self.batch_size)
+        ]
+
+        def build(bucket, chunk):
+            recs = [self.roidb[i] for i in chunk]
+            batch = make_batch(
+                recs, self.cfg, bucket, proposal_count=self.proposal_count
+            )
+            return chunk, recs, batch
+
+        source = (build(bucket, chunk) for bucket, chunk in plan)
+        yield from _prefetch_iter(source, prefetch)

@@ -66,11 +66,15 @@ def _sample_coords(c1, c2, size: int, nbins: int, s: int):
 
 
 def _matrices_for_roi(rois_ref, b, r, hf: int, wf: int, pooled, s: int, scale: float):
+    """``rois_ref`` is scalar-prefetched SMEM in (B, 4, R) layout — the
+    coordinate dim must NOT be minor: SMEM pads the minor dim to 128
+    lanes, so (B, R, 4) would blow up 32× and overflow the 1 MB SMEM at
+    eval roi counts (B=8, R=300 → 1.2 MB)."""
     ph, pw = pooled
-    x1 = rois_ref[b, r, 0] * scale
-    y1 = rois_ref[b, r, 1] * scale
-    x2 = rois_ref[b, r, 2] * scale
-    y2 = rois_ref[b, r, 3] * scale
+    x1 = rois_ref[b, 0, r] * scale
+    y1 = rois_ref[b, 1, r] * scale
+    x2 = rois_ref[b, 2, r] * scale
+    y2 = rois_ref[b, 3, r] * scale
     ylo, ywhi = _sample_coords(y1, y2, hf, ph, s)
     xlo, xwhi = _sample_coords(x1, x2, wf, pw, s)
     my = _interp_matrix(ylo, ywhi, hf, ph, s)                        # (PH, H)
@@ -196,7 +200,7 @@ def _roi_align_fwd_impl(feat, rois, pooled, scale, s, interpret):
         ),
         out_shape=jax.ShapeDtypeStruct((b, r, pooled[0], pooled[1], c), feat.dtype),
         interpret=interpret,
-    )(rois.astype(jnp.float32), feat)
+    )(rois.astype(jnp.float32).transpose(0, 2, 1), feat)
 
 
 def _roi_align_bwd_impl(feat_shape, feat_dtype, rois, g, pooled, scale, s, interpret):
@@ -226,7 +230,7 @@ def _roi_align_bwd_impl(feat_shape, feat_dtype, rois, g, pooled, scale, s, inter
         # (B, W, H, C): the kernel accumulates transposed (see docstring)
         out_shape=jax.ShapeDtypeStruct((b, wf, hf, c), jnp.float32),
         interpret=interpret,
-    )(rois.astype(jnp.float32), g)
+    )(rois.astype(jnp.float32).transpose(0, 2, 1), g)
     return out.swapaxes(1, 2).astype(feat_dtype)
 
 

@@ -1,0 +1,115 @@
+"""Inference throughput benchmark: flagship test-mode forward + host NMS.
+
+Reference: the reference published no inference throughput; its tester
+(``rcnn/core/tester.py :: pred_eval``) was hardwired batch=1 with two
+host round-trips per image.  Here the whole test forward (backbone →
+RPN → proposal NMS → roi head → decoded deltas) is one jitted graph per
+shape bucket, batched across images, with only the per-class NMS on the
+host (native C, ``native/hostops.c``).
+
+Usage: python -m mx_rcnn_tpu.tools.bench_eval [--batch 8] [--images 64]
+Prints one JSON line {"metric": "eval_imgs_per_sec_per_chip_...", ...}.
+
+Caveat: on a relay-attached TPU with a weak host (the dev box has one
+CPU core), this measures the HOST — image assembly is ~80 ms/img there
+and the 76 MB/batch upload rides the relay tunnel; the device forward is
+a small fraction.  The TestLoader prefetch thread overlaps assembly with
+the device on real hosts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    from mx_rcnn_tpu.utils.platform import cli_bootstrap, enable_compile_cache
+
+    cli_bootstrap()
+    enable_compile_cache()
+
+    import dataclasses
+
+    import numpy as np
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.core.tester import Predictor, im_detect
+    from mx_rcnn_tpu.data.loader import TestLoader
+    from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.native.hostops import nms_host
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--images", type=int, default=64)
+    ap.add_argument("--network", default="resnet")
+    ap.add_argument("--compute_dtype", default="bfloat16")
+    args = ap.parse_args()
+
+    cfg = generate_config(args.network, "PascalVOC")
+    cfg = cfg.replace(
+        network=dataclasses.replace(
+            cfg.network, COMPUTE_DTYPE=args.compute_dtype
+        )
+    )
+    h, w = cfg.SHAPE_BUCKETS[0]
+    imdb = SyntheticDataset(
+        num_images=args.images,
+        num_classes=cfg.dataset.NUM_CLASSES,
+        image_size=(h - 8, w - 24),  # inside the padded canvas
+        max_boxes=6,
+    )
+    roidb = imdb.gt_roidb()
+
+    import jax
+
+    model = build_model(cfg)
+    params = model.init(
+        {"params": jax.random.key(0)},
+        np.zeros((1, h, w, 3), np.float32),
+        np.array([[h, w, 1.0]], np.float32),
+        train=False,
+    )["params"]
+    predictor = Predictor(model, params)
+    loader = TestLoader(roidb, cfg, batch_size=args.batch)
+
+    def sweep():
+        n_det = 0
+        for idxs, recs, batch in loader.iter_batched():
+            out = predictor.predict(batch)
+            for k, (i, rec) in enumerate(zip(idxs, recs)):
+                det = im_detect(
+                    out, batch["im_info"][k], (rec["height"], rec["width"]),
+                    index=k,
+                )
+                for j in range(1, imdb.num_classes):
+                    keep = np.where(det["scores"][:, j] > 0.05)[0]
+                    cls = np.hstack([
+                        det["boxes"][keep, j * 4 : (j + 1) * 4],
+                        det["scores"][keep, j : j + 1],
+                    ]).astype(np.float32)
+                    n_det += len(nms_host(cls, cfg.TEST.NMS))
+        return n_det
+
+    sweep()  # warmup / compile
+    t0 = time.perf_counter()
+    n_det = sweep()
+    dt = time.perf_counter() - t0
+    imgs_per_sec = args.images / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"eval_imgs_per_sec_per_chip_{args.network}",
+                "value": round(imgs_per_sec, 3),
+                "unit": "imgs/sec/chip",
+                "batch": args.batch,
+                "detections": int(n_det),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

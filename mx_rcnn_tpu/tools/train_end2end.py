@@ -86,6 +86,9 @@ def parse_args(argv=None):
                    help="multi-host training: total number of processes")
     p.add_argument("--dist_procid", type=int, default=None,
                    help="multi-host training: this process's id")
+    p.add_argument("--metrics_jsonl", default=None, metavar="PATH",
+                   help="append one JSON line of metrics per logging "
+                        "interval (structured twin of the Speedometer log)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of steps 10-20 into "
                         "DIR (view with tensorboard/xprof)")
@@ -265,7 +268,10 @@ def train_net(args):
         return bool(np.asarray(votes).any())
 
     tracker = MetricTracker()
-    speedo = Speedometer(global_batch, args.frequent)
+    # only process 0 writes the metrics file: every process computing
+    # global-batch throughput into a shared path would duplicate records
+    jsonl = args.metrics_jsonl if jax.process_index() == 0 else None
+    speedo = Speedometer(global_batch, args.frequent, jsonl_path=jsonl)
     rng = jax.random.key(args.seed + 123)
     total_steps = 0
     tracing = False

@@ -159,3 +159,20 @@ class TestNumpyTwins:
         z = np.zeros((2, 4), np.float32)
         assert np.isfinite(np_transform(z, z)).all()
         assert np.isfinite(np.asarray(bbox_transform(z, z))).all()
+
+    def test_np_bbox_pred_clip_match_ops(self, rng):
+        from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
+        from mx_rcnn_tpu.utils.bbox_stats import np_bbox_pred, np_clip_boxes
+
+        boxes = rng.rand(11, 4).astype(np.float32) * 200
+        boxes[:, 2:] += boxes[:, :2]
+        deltas = (rng.randn(11, 4 * 5) * 0.3).astype(np.float32)
+        deltas[0, 2] = 10.0  # hits the dw/dh clip in both paths
+        got = np_bbox_pred(boxes, deltas)
+        want = np.asarray(bbox_pred(boxes, deltas))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-3)
+        np.testing.assert_allclose(
+            np_clip_boxes(got, (300, 400)),
+            np.asarray(clip_boxes(want, (300, 400))),
+            rtol=1e-5, atol=1e-3,
+        )

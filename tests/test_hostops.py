@@ -81,3 +81,18 @@ def test_bbox_overlaps_empty():
     assert hostops.bbox_overlaps_host(
         np.zeros((0, 4), np.float32), np.zeros((3, 4), np.float32)
     ).shape == (0, 3)
+
+
+def test_numpy_fallback_matches_native(monkeypatch):
+    # compiler-less deployments take the numpy branch; it must agree
+    rng = np.random.RandomState(2)
+    dets = _random_dets(rng, 200)
+    want_nms = hostops.nms_host(dets, 0.5)
+    want_ov = hostops.bbox_overlaps_host(dets[:, :4], dets[:50, :4])
+    monkeypatch.setattr(hostops, "_LIB", None)
+    monkeypatch.setattr(hostops, "_TRIED", True)
+    assert hostops.nms_host(dets, 0.5) == want_nms
+    np.testing.assert_allclose(
+        hostops.bbox_overlaps_host(dets[:, :4], dets[:50, :4]),
+        want_ov, rtol=1e-5, atol=1e-6,
+    )

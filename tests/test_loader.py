@@ -113,3 +113,25 @@ class TestDsUtils:
         np.testing.assert_array_equal(
             filter_small_boxes(boxes, 4), [0, 1, 2]
         )
+
+
+def test_prefetch_iter_propagates_worker_exception():
+    """A decode error inside the prefetch thread must reach the consumer
+    — swallowing it would silently truncate an epoch or an eval sweep."""
+    import pytest
+
+    from mx_rcnn_tpu.data.loader import _prefetch_iter
+
+    def source():
+        yield 1
+        yield 2
+        raise RuntimeError("decode failed")
+
+    got = []
+    with pytest.raises(RuntimeError, match="decode failed"):
+        for x in _prefetch_iter(source(), prefetch=2):
+            got.append(x)
+    assert got == [1, 2]
+    # prefetch=0 path propagates too
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(_prefetch_iter(source(), prefetch=0))

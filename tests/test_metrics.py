@@ -1,0 +1,42 @@
+"""MetricTracker / Speedometer (reference: rcnn/core/{metric,callback}.py)
+including the structured-JSONL logging upgrade (SURVEY §5.6)."""
+
+import json
+
+from mx_rcnn_tpu.core.metrics import MetricTracker, Speedometer
+
+
+def test_tracker_averages_and_resets():
+    t = MetricTracker(names=("RPNAcc", "RCNNAcc"))
+    t.update({"RPNAcc": 0.5, "RCNNAcc": 0.0})
+    t.update({"RPNAcc": 1.0, "RCNNAcc": 1.0})
+    got = t.get()
+    assert got["RPNAcc"] == 0.75 and got["RCNNAcc"] == 0.5
+    assert "RPNAcc=0.75" in t.format()
+    t.reset()
+    assert all(v == 0.0 for v in t.get().values())
+
+
+def test_speedometer_jsonl(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    speedo = Speedometer(batch_size=4, frequent=2, jsonl_path=path)
+    t = MetricTracker(names=("RPNAcc",))
+    for step in range(1, 5):
+        t.update({"RPNAcc": float(step)})
+        speedo(epoch=0, step=step, tracker=t)
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2  # steps 2 and 4
+    assert [l["step"] for l in lines] == [2, 4]
+    for l in lines:
+        assert l["epoch"] == 0
+        assert l["samples_per_sec"] > 0
+    # tracker resets between intervals: the step-4 line averages steps 3..4
+    assert lines[0]["RPNAcc"] == 1.5
+    assert lines[1]["RPNAcc"] == 3.5
+
+
+def test_speedometer_no_jsonl_by_default():
+    speedo = Speedometer(batch_size=1, frequent=1)
+    t = MetricTracker(names=("RPNAcc",))
+    t.update({"RPNAcc": 1.0})
+    speedo(0, 1, t)  # must not raise or write anywhere
