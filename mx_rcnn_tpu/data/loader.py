@@ -127,24 +127,42 @@ def _prefetch_iter(source, prefetch: int):
         yield from source
         return
     q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+    abandoned = threading.Event()
+
+    def _put(msg) -> bool:
+        # bounded put that gives up once the consumer is gone — a plain
+        # q.put would park this thread forever when the generator is
+        # abandoned mid-iteration (exception in the consumer, partial
+        # eval, GC), leaking the thread plus prefetch+1 pinned batches
+        while not abandoned.is_set():
+            try:
+                q.put(msg, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for item in source:
-                q.put(("item", item))
-            q.put(("stop", None))
+                if not _put(("item", item)):
+                    return
+            _put(("stop", None))
         except BaseException as e:  # noqa: BLE001 — handed to the consumer
-            q.put(("err", e))
+            _put(("err", e))
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
-    while True:
-        kind, payload = q.get()
-        if kind == "stop":
-            return
-        if kind == "err":
-            raise payload
-        yield payload
+    try:
+        while True:
+            kind, payload = q.get()
+            if kind == "stop":
+                return
+            if kind == "err":
+                raise payload
+            yield payload
+    finally:
+        abandoned.set()
 
 
 class TrainLoader:

@@ -29,10 +29,29 @@ def build_and_load(src_path: str, so_name: str) -> Optional[ctypes.CDLL]:
     cache_dir = os.path.join(cache_dir, "mx_rcnn_tpu")
     so_path = os.path.join(cache_dir, so_name)
     try:
+        # The ownership/mode gate must run BEFORE the freshness test:
+        # chmod only inside the rebuild branch would still dlopen an
+        # up-to-date pre-seeded .so without ever re-asserting the mode.
+        # makedirs mode applies only on creation (and is umask-filtered),
+        # so re-assert 0700 — via an O_NOFOLLOW fd so the islink/stat/
+        # chmod sequence cannot be raced with a planted symlink (path
+        # chmod follows symlinks and would re-mode a victim directory).
+        os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+        dfd = os.open(
+            cache_dir,
+            os.O_RDONLY | getattr(os, "O_DIRECTORY", 0)
+            | getattr(os, "O_NOFOLLOW", 0),
+        )
+        try:
+            st = os.fstat(dfd)
+            if hasattr(os, "getuid") and st.st_uid != os.getuid():
+                raise RuntimeError(f"cache dir {cache_dir} not owned by us")
+            os.fchmod(dfd, 0o700)
+        finally:
+            os.close(dfd)
         if (not os.path.exists(so_path)) or (
             os.path.getmtime(so_path) < os.path.getmtime(src_path)
         ):
-            os.makedirs(cache_dir, mode=0o700, exist_ok=True)
             cc = os.environ.get("CC", "cc")
             fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
             os.close(fd)

@@ -128,19 +128,28 @@ def _bwd_kernel(rois_ref, g_ref, dfeat_ref, *, pooled, s, scale):
     laid out TRANSPOSED, (W, H, CB) — the second dot emits that order,
     and one XLA transpose of the final (B, W, H, C) outside the kernel
     replaces B·R·(C/CB) in-kernel transposes (measured 35 ms → a few ms
-    on the flagship step) — and the dots run at default MXU precision:
-    the incoming cotangent is bf16 in the bf16 training graph, so 6-pass
-    HIGHEST f32 buys nothing the rest of the backward has."""
+    on the flagship step).  Precision mirrors the forward's dtype
+    branch: bf16 cotangents (the bf16 training graph) take default MXU
+    passes — 6-pass HIGHEST buys nothing the rest of that backward
+    has — while f32 cotangents (COMPUTE_DTYPE=float32 runs) keep
+    HIGHEST so gradients round at ~1e-5, not bf16-mantissa ~1e-3."""
     b, r = pl.program_id(0), pl.program_id(2)
     wf, hf = dfeat_ref.shape[1], dfeat_ref.shape[2]
     my, mx = _matrices_for_roi(rois_ref, b, r, hf, wf, pooled, s, scale)
+    prec = (
+        jax.lax.Precision.HIGHEST
+        if g_ref.dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
     g = g_ref[0, 0].astype(jnp.float32)                              # (PH, PW, CB)
     # t: (H, PW, CB) = Myᵀ contract PH;  d: (W, H, CB) = Mxᵀ contract PW
     t = jax.lax.dot_general(
         my, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=prec,
     )                                                                # (H, PW, CB)
     d = jax.lax.dot_general(
         mx, t, (((0,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        precision=prec,
     )                                                                # (W, H, CB)
 
     @pl.when(r == 0)

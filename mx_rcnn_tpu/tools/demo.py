@@ -101,7 +101,7 @@ def main():
         params = load_params(args.params)
     else:
         from mx_rcnn_tpu.core.checkpoint import (
-            latest_epoch,
+            latest_checkpoint,
             load_checkpoint,
         )
         from mx_rcnn_tpu.core.train import create_train_state, make_optimizer
@@ -114,11 +114,18 @@ def main():
             np.array([[h, w, 1.0]], np.float32),
             train=False,
         )["params"]
-        epoch = args.epoch if args.epoch is not None else latest_epoch(args.prefix)
-        if epoch is not None:
+        # same (epoch, batch) newest-wins resolution as tools/test.py —
+        # a mid-epoch preemption dump beats the older boundary save
+        found = (
+            (args.epoch, 0) if args.epoch is not None
+            else latest_checkpoint(args.prefix)
+        )
+        if found is not None:
+            epoch, batch_in_epoch = found
             tx = make_optimizer(cfg, lambda s: 0.0)
             state = load_checkpoint(
-                args.prefix, epoch, create_train_state(params, tx)
+                args.prefix, epoch, create_train_state(params, tx),
+                batch_in_epoch=batch_in_epoch,
             )
             params = state.params
         else:

@@ -17,11 +17,7 @@ import logging
 import jax
 
 from mx_rcnn_tpu.config import generate_config
-from mx_rcnn_tpu.core.checkpoint import (
-    latest_checkpoint,
-    latest_epoch,
-    load_checkpoint,
-)
+from mx_rcnn_tpu.core.checkpoint import latest_checkpoint, load_checkpoint
 from mx_rcnn_tpu.core.tester import Predictor, pred_eval
 from mx_rcnn_tpu.core.train import create_train_state, make_optimizer
 from mx_rcnn_tpu.data.loader import TestLoader
@@ -96,12 +92,12 @@ def test_rcnn(args):
         if args.epoch is not None:
             found = (args.epoch, 0)
         else:
-            # prefer epoch-boundary checkpoints, but fall back to a
-            # mid-epoch step_EEEE_SSSSSS preemption dump so a run
-            # preempted before its first epoch boundary does not get
-            # silently evaluated at random init
-            epoch = latest_epoch(args.prefix)
-            found = (epoch, 0) if epoch is not None else latest_checkpoint(args.prefix)
+            # latest_checkpoint orders epoch-boundary saves and mid-epoch
+            # step_EEEE_SSSSSS preemption dumps on one (epoch, batch)
+            # axis, so the newest state always wins — a run preempted
+            # mid-epoch after its last boundary save evaluates the step
+            # dump, not the older boundary weights
+            found = latest_checkpoint(args.prefix)
         if found is not None:
             epoch, batch_in_epoch = found
             tx = make_optimizer(cfg, lambda s: 0.0)
