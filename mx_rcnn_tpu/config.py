@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass, field
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 
 @dataclass(frozen=True)
@@ -37,6 +37,13 @@ class TrainConfig:
     BBOX_NORMALIZATION_PRECOMPUTED: bool = True
     BBOX_MEANS: Tuple[float, float, float, float] = (0.0, 0.0, 0.0, 0.0)
     BBOX_STDS: Tuple[float, float, float, float] = (0.1, 0.1, 0.2, 0.2)
+    # per-class (K, 4) normalization tables — the reference's
+    # BBOX_NORMALIZATION_PRECOMPUTED path in add_bbox_regression_targets
+    # computes per-class means/stds; when set (by train_rcnn's roidb
+    # precompute) they override the class-agnostic vectors above in both
+    # sample_rois normalization and test-time de-normalization
+    BBOX_MEANS_PER_CLASS: Optional[Tuple[Tuple[float, ...], ...]] = None
+    BBOX_STDS_PER_CLASS: Optional[Tuple[Tuple[float, ...], ...]] = None
     # RPN anchor target assignment (reference: rcnn/io/rpn.py :: assign_anchor)
     RPN_BATCH_SIZE: int = 256
     RPN_FG_FRACTION: float = 0.5
@@ -65,6 +72,11 @@ class TrainConfig:
     LR_FACTOR: float = 0.1
     # mask head (Mask R-CNN extension; not in reference)
     MASK_SIZE: int = 28
+    # gt bitmap resolution in the gt-box frame (data/masks.py): each
+    # gt's polygons rasterize once to (M, M); in-graph targets resample
+    # under the roi grid.  64 ≈ 2.3× the 28-cell target grid — enough
+    # that bilinear resampling, not the bitmap, bounds target fidelity.
+    MASK_GT_SIZE: int = 64
 
 
 @dataclass(frozen=True)

@@ -76,6 +76,7 @@ def fit(
     batch0 = make_batch(
         first, cfg, _orientation_bucket(first[0], cfg.SHAPE_BUCKETS),
         proposal_count=proposal_count, seeds=list(range(len(first))),
+        with_masks=cfg.network.USE_MASK,
     )
     params = model.init(
         {"params": jax.random.key(seed), "sampling": jax.random.key(seed + 1)},

@@ -56,7 +56,7 @@ class COCO(IMDB):
     def _load_annotation(self, index: int) -> Dict:
         im = self._images[index]
         width, height = im["width"], im["height"]
-        boxes, classes = [], []
+        boxes, classes, segms = [], [], []
         for ann in self._anns_by_image[index]:
             if ann.get("iscrowd", 0):
                 continue
@@ -69,12 +69,19 @@ class COCO(IMDB):
             if ann.get("area", 1) > 0 and x2 >= x1 and y2 >= y1:
                 boxes.append([x1, y1, x2, y2])
                 classes.append(self._cat_id_to_class[ann["category_id"]])
+                # polygons (list) or uncompressed RLE dict; absent or
+                # malformed → None, trained as a rectangle target
+                segm = ann.get("segmentation")
+                if not (isinstance(segm, (list, dict)) and segm):
+                    segm = None
+                segms.append(segm)
         return {
             "image": self.image_path(index),
             "height": height,
             "width": width,
             "boxes": np.asarray(boxes, np.float32).reshape(-1, 4),
             "gt_classes": np.asarray(classes, np.int32),
+            "segmentation": segms,
             "flipped": False,
         }
 

@@ -7,7 +7,8 @@ boxes with validity asserts), abstract ``evaluate_detections``.
 roidb record keys (superset of the reference's, minus the
 selective-search legacy fields):
   image (path), height, width, boxes (n, 4) f32, gt_classes (n,) i32,
-  flipped (bool).
+  flipped (bool), and optionally segmentation (len-n list of COCO
+  polygon lists / RLE dicts / None, parallel to boxes — Mask R-CNN gt).
 """
 
 from __future__ import annotations
@@ -80,6 +81,12 @@ class IMDB:
             new_rec = dict(rec)
             new_rec["boxes"] = boxes
             new_rec["flipped"] = True
+            if rec.get("segmentation") is not None:
+                from mx_rcnn_tpu.data.masks import flip_segmentations
+
+                new_rec["segmentation"] = flip_segmentations(
+                    rec["segmentation"], rec["width"]
+                )
             if "proposals" in rec and len(rec["proposals"]):
                 props = rec["proposals"].copy()
                 oldx1 = props[:, 0].copy()

@@ -33,9 +33,12 @@ def _load_record_image(rec: Dict) -> np.ndarray:
     if str(rec["image"]).startswith("synthetic://"):
         from mx_rcnn_tpu.data.synthetic import synthetic_image
 
-        im = synthetic_image(rec, rec["synthetic_seed"])
-    else:
-        im = load_image(rec["image"])
+        # synthetic records render from their OWN (already-flipped)
+        # geometry — flipping again would move pixels back to the
+        # unflipped positions while gt stays flipped, silently training
+        # half the flip-augmented epoch on mismatched targets
+        return synthetic_image(rec, rec["synthetic_seed"])
+    im = load_image(rec["image"])
     if rec.get("flipped"):
         im = im[:, ::-1]
     return im
@@ -48,6 +51,7 @@ def make_batch(
     images: Optional[Sequence[np.ndarray]] = None,
     proposal_count: int = 0,
     seeds: Optional[Sequence[int]] = None,
+    with_masks: bool = False,
 ) -> Dict[str, np.ndarray]:
     """Assemble one padded train batch from roidb records.
 
@@ -58,6 +62,12 @@ def make_batch(
     padded to that count from each record's ``proposals`` field (the
     ROIIter role: Fast-RCNN batches from a proposal roidb,
     ``rcnn/io/rcnn.py :: get_rcnn_batch``).
+
+    ``with_masks`` emits ``gt_masks`` (n, G, M, M) uint8 box-frame
+    bitmaps (M = TRAIN.MASK_GT_SIZE) for Mask R-CNN training — records
+    without a ``segmentation`` field get all-ones bitmaps (rectangle
+    targets, the box-only convention).  Bitmaps are box-relative, so the
+    resize scale does not affect them.
     """
     scales = cfg.dataset.SCALES[0]
     g = cfg.dataset.MAX_GT_BOXES
@@ -67,6 +77,11 @@ def make_batch(
     im_info = np.zeros((n, 3), np.float32)
     gt_boxes = np.zeros((n, g, 5), np.float32)
     gt_valid = np.zeros((n, g), bool)
+    if with_masks:
+        from mx_rcnn_tpu.data.masks import record_gt_masks
+
+        msize = cfg.TRAIN.MASK_GT_SIZE
+        gt_masks = np.zeros((n, g, msize, msize), np.uint8)
     if proposal_count:
         proposals = np.zeros((n, proposal_count, 4), np.float32)
         prop_valid = np.zeros((n, proposal_count), bool)
@@ -87,6 +102,9 @@ def make_batch(
         gt_boxes[i, :k, :4] = boxes[:k]
         gt_boxes[i, :k, 4] = rec["gt_classes"][:k]
         gt_valid[i, :k] = True
+        if with_masks:
+            rec_masks = record_gt_masks(rec, g, msize)
+            gt_masks[i] = 1 if rec_masks is None else rec_masks
         if proposal_count:
             p = np.asarray(rec["proposals"], np.float32) * info[2]
             k = min(len(p), proposal_count)
@@ -98,6 +116,8 @@ def make_batch(
         "gt_boxes": gt_boxes,
         "gt_valid": gt_valid,
     }
+    if with_masks:
+        out["gt_masks"] = gt_masks
     if seeds is not None:
         # per-image sampling seeds: in-graph roi/anchor subsampling keys
         # derive from these, making draws identical across DP topologies
@@ -233,6 +253,7 @@ class TrainLoader:
             make_batch(
                 [self.roidb[i] for i in idxs], self.cfg, bucket,
                 proposal_count=pc, seeds=idxs,
+                with_masks=self.cfg.network.USE_MASK,
             )
             for bucket, idxs in plan
         )

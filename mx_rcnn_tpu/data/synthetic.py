@@ -3,8 +3,14 @@
 No reference twin — this is the rebuild's "fake backend" for tests,
 smoke-training, and benchmarking in environments without VOC/COCO on disk
 (SURVEY §5.1's do-better-cheaply test strategy).  Images are generated in
-memory with colored rectangles on noise so a detector can genuinely
-overfit them; boxes are the rectangle coordinates.
+memory with colored shapes on noise so a detector can genuinely overfit
+them; boxes are the shape bounding boxes.
+
+``with_masks=True`` additionally emits COCO-style polygon
+``segmentation`` gts (ellipses / triangles / rectangles inscribed in
+each box) and renders the POLYGON region, not the box — the visual
+signal matches the mask gt, so a Mask R-CNN head can genuinely learn
+non-rectangular shapes and the segm eval stack can be gated end-to-end.
 """
 
 from __future__ import annotations
@@ -30,17 +36,55 @@ def class_color(cls: int) -> np.ndarray:
     return np.asarray(rgb, np.float32)
 
 
+def shape_polygon(kind: str, box, t: float = 0.5) -> List[float]:
+    """One polygon ([x1, y1, x2, y2, ...] continuous coords) of ``kind``
+    inscribed in ``box`` (inclusive pixel indices) with a tight bbox.
+
+    ``t`` ∈ (0, 1) parameterizes the triangle apex position.
+    """
+    x1, y1, x2, y2 = (float(v) for v in box[:4])
+    # continuous extents: pixel p covers [p, p+1)
+    cx2, cy2 = x2 + 1.0, y2 + 1.0
+    if kind == "rect":
+        return [x1, y1, cx2, y1, cx2, cy2, x1, cy2]
+    if kind == "triangle":
+        apex_x = x1 + t * (cx2 - x1)
+        return [x1, cy2, cx2, cy2, apex_x, y1]
+    # ellipse inscribed in the box (24-gon approximation)
+    mx, my = (x1 + cx2) / 2.0, (y1 + cy2) / 2.0
+    rx, ry = (cx2 - x1) / 2.0, (cy2 - y1) / 2.0
+    th = np.linspace(0.0, 2.0 * np.pi, 24, endpoint=False)
+    pts = np.stack([mx + rx * np.cos(th), my + ry * np.sin(th)], axis=1)
+    return pts.reshape(-1).tolist()
+
+
 def synthetic_image(rec: Dict, seed: int) -> np.ndarray:
-    """Render the record: noise background + filled class-colored boxes."""
+    """Render the record: noise background + filled class-colored shapes.
+
+    Renders from the record's OWN (possibly flipped) geometry — the
+    loader must NOT flip the result again (see
+    ``data/loader.py::_load_record_image``): flipping an image rendered
+    from already-flipped boxes would cancel out and desynchronize pixels
+    from gt.
+    """
     rng = np.random.RandomState(seed)
     h, w = rec["height"], rec["width"]
     im = rng.rand(h, w, 3).astype(np.float32) * 60.0 + 90.0
-    for box, cls in zip(rec["boxes"], rec["gt_classes"]):
+    segms = rec.get("segmentation")
+    for i, (box, cls) in enumerate(zip(rec["boxes"], rec["gt_classes"])):
         x1, y1, x2, y2 = box.astype(int)
         color = class_color(int(cls))
-        im[y1 : y2 + 1, x1 : x2 + 1] = color + rng.rand(
-            y2 - y1 + 1, x2 - x1 + 1, 3
-        ).astype(np.float32) * 10.0
+        block = color + rng.rand(y2 - y1 + 1, x2 - x1 + 1, 3).astype(np.float32) * 10.0
+        segm = segms[i] if segms is not None else None
+        if segm is None:
+            im[y1 : y2 + 1, x1 : x2 + 1] = block
+        else:
+            from mx_rcnn_tpu.native import rle as rlelib
+
+            full = rlelib.decode(rlelib.from_polygons(segm, h, w))
+            m = full[y1 : y2 + 1, x1 : x2 + 1].astype(bool)
+            region = im[y1 : y2 + 1, x1 : x2 + 1]
+            region[m] = block[m]
     return im
 
 
@@ -52,6 +96,7 @@ class SyntheticDataset(IMDB):
         image_size=(480, 640),
         max_boxes: int = 4,
         seed: int = 0,
+        with_masks: bool = False,
     ):
         super().__init__(f"synthetic_{num_images}", root_path="/tmp")
         self.classes = ["__background__"] + [
@@ -61,6 +106,7 @@ class SyntheticDataset(IMDB):
         self.seed = seed
         self.image_size = image_size
         self.max_boxes = max_boxes
+        self.with_masks = with_masks
 
     def gt_roidb(self) -> List[Dict]:
         rng = np.random.RandomState(self.seed)
@@ -68,29 +114,83 @@ class SyntheticDataset(IMDB):
         roidb = []
         for i in self.image_set_index:
             n = rng.randint(1, self.max_boxes + 1)
-            boxes, classes = [], []
+            boxes, classes, segms = [], [], []
             for _ in range(n):
                 bw = rng.randint(60, w // 2)
                 bh = rng.randint(60, h // 2)
                 x1 = rng.randint(0, w - bw)
                 y1 = rng.randint(0, h - bh)
-                boxes.append([x1, y1, x1 + bw - 1, y1 + bh - 1])
+                box = [x1, y1, x1 + bw - 1, y1 + bh - 1]
+                boxes.append(box)
                 classes.append(rng.randint(1, self.num_classes))
-            roidb.append(
-                {
-                    "image": f"synthetic://{i}",
-                    "height": h,
-                    "width": w,
-                    "boxes": np.asarray(boxes, np.float32),
-                    "gt_classes": np.asarray(classes, np.int32),
-                    "flipped": False,
-                    "synthetic_seed": self.seed + 1000 + i,
-                }
-            )
+                if self.with_masks:
+                    kind = ("ellipse", "triangle", "rect")[rng.randint(3)]
+                    segms.append(
+                        [shape_polygon(kind, box, t=rng.uniform(0.25, 0.75))]
+                    )
+            rec = {
+                "image": f"synthetic://{i}",
+                "height": h,
+                "width": w,
+                "boxes": np.asarray(boxes, np.float32),
+                "gt_classes": np.asarray(classes, np.int32),
+                "flipped": False,
+                "synthetic_seed": self.seed + 1000 + i,
+            }
+            if self.with_masks:
+                rec["segmentation"] = segms
+            roidb.append(rec)
         return roidb
 
-    def evaluate_detections(self, detections, **kw):
-        """VOC-style mAP against the synthetic gt (integral metric)."""
+    def as_coco_dict(self) -> Dict:
+        """COCO-format instances dict over the synthetic gt — feeds the
+        reimplemented COCOeval so the Mask R-CNN gate runs the REAL segm
+        protocol (polygon gt → RLE IoU → 12 metrics) end-to-end."""
+        roidb = self.gt_roidb()
+        images, annotations = [], []
+        ann_id = 1
+        for i, rec in enumerate(roidb):
+            images.append(
+                {"id": i, "height": rec["height"], "width": rec["width"]}
+            )
+            segms = rec.get("segmentation")
+            for j, (box, cls) in enumerate(zip(rec["boxes"], rec["gt_classes"])):
+                x1, y1, x2, y2 = (float(v) for v in box)
+                ann = {
+                    "id": ann_id,
+                    "image_id": i,
+                    "category_id": int(cls),
+                    "bbox": [x1, y1, x2 - x1 + 1.0, y2 - y1 + 1.0],
+                    "area": (x2 - x1 + 1.0) * (y2 - y1 + 1.0),
+                    "iscrowd": 0,
+                }
+                if segms is not None:
+                    from mx_rcnn_tpu.native import rle as rlelib
+
+                    ann["segmentation"] = segms[j]
+                    # protocol: segm area-range bucketing uses the MASK
+                    # area, not the box area (a thin triangle can land
+                    # in a smaller bucket than its box)
+                    ann["area"] = rlelib.area(
+                        rlelib.from_polygons(
+                            segms[j], rec["height"], rec["width"]
+                        )
+                    )
+                annotations.append(ann)
+                ann_id += 1
+        return {
+            "images": images,
+            "annotations": annotations,
+            "categories": [
+                {"id": c, "name": self.classes[c]}
+                for c in range(1, self.num_classes)
+            ],
+        }
+
+    def evaluate_detections(self, detections, all_masks=None, **kw):
+        """VOC-style box mAP against the synthetic gt (integral metric);
+        with ``all_masks`` additionally runs the COCO segm protocol and
+        reports its stats under ``segm_*`` keys."""
         from mx_rcnn_tpu.eval.voc_eval import voc_eval
 
         roidb = self.gt_roidb()
@@ -112,4 +212,31 @@ class SyntheticDataset(IMDB):
             aps[f"class{cls_idx}"] = ap
         vals = [v for v in aps.values()]
         aps["mAP"] = float(np.mean(vals)) if vals else 0.0
+
+        if all_masks is not None:
+            from mx_rcnn_tpu.eval.coco_eval import COCOEvalBbox
+
+            results = []
+            for cls_idx in range(1, self.num_classes):
+                for i in range(len(roidb)):
+                    dets = np.asarray(detections[cls_idx][i]).reshape(-1, 5)
+                    for d, (x1, y1, x2, y2, score) in enumerate(dets):
+                        results.append(
+                            {
+                                "image_id": i,
+                                "category_id": cls_idx,
+                                "bbox": [
+                                    float(x1),
+                                    float(y1),
+                                    float(x2 - x1 + 1),
+                                    float(y2 - y1 + 1),
+                                ],
+                                "score": float(score),
+                                "segmentation": all_masks[cls_idx][i][d],
+                            }
+                        )
+            segm_stats = COCOEvalBbox(
+                self.as_coco_dict(), results, iou_type="segm"
+            ).evaluate(verbose=False)
+            aps.update({f"segm_{k}": v for k, v in segm_stats.items()})
         return aps

@@ -52,7 +52,7 @@ from mx_rcnn_tpu.ops.losses import (
 from mx_rcnn_tpu.ops.nms import nms
 from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
 from mx_rcnn_tpu.ops.roi_align import extract_roi_features_batched
-from mx_rcnn_tpu.ops.targets import assign_anchor, sample_rois
+from mx_rcnn_tpu.ops.targets import assign_anchor, bbox_denorm_vectors, sample_rois
 
 _NEG_INF = -1e10
 
@@ -245,14 +245,16 @@ class FPNFasterRCNN(nn.Module):
         gt_valid: Optional[jnp.ndarray] = None,
         train: bool = False,
         sample_seeds: Optional[jnp.ndarray] = None,
+        gt_masks: Optional[jnp.ndarray] = None,
     ):
         if train:
             return self.train_forward(
-                images, im_info, gt_boxes, gt_valid, sample_seeds
+                images, im_info, gt_boxes, gt_valid, sample_seeds, gt_masks
             )
         return self.test_forward(images, im_info)
 
-    def train_forward(self, images, im_info, gt_boxes, gt_valid, sample_seeds=None):
+    def train_forward(self, images, im_info, gt_boxes, gt_valid,
+                      sample_seeds=None, gt_masks=None):
         cfg = self.cfg
         t = cfg.TRAIN
         b = images.shape[0]
@@ -329,7 +331,7 @@ class FPNFasterRCNN(nn.Module):
 
         if cfg.network.USE_MASK:
             mask_loss, mask_aux = self._mask_loss(
-                pyramid, samples, gt_boxes, gt_valid
+                pyramid, samples, gt_boxes, gt_valid, gt_masks
             )
             total = total + mask_loss
             aux.update(mask_aux)
@@ -355,8 +357,7 @@ class FPNFasterRCNN(nn.Module):
         trunk = self._roi_features(pyramid, rois)
         cls_logits, bbox_deltas = self.rcnn(trunk)
         r = te.RPN_POST_NMS_TOP_N
-        means = jnp.tile(jnp.asarray(cfg.TRAIN.BBOX_MEANS, jnp.float32), k)
-        stds = jnp.tile(jnp.asarray(cfg.TRAIN.BBOX_STDS, jnp.float32), k)
+        means, stds = bbox_denorm_vectors(cfg, k)
         bbox_deltas = bbox_deltas * stds[None, :] + means[None, :]
         out = {
             "rois": rois,
@@ -392,16 +393,25 @@ class FPNFasterRCNN(nn.Module):
         logits = self.mask_head(self._mask_pooled(pyramid, rois))
         return logits.reshape((b, r) + logits.shape[1:])
 
-    def _mask_loss(self, pyramid, samples, gt_boxes, gt_valid):
+    def _mask_loss(self, pyramid, samples, gt_boxes, gt_valid, gt_masks=None):
         """Per-fg-roi BCE against gt masks cropped to the roi (28×28).
 
-        Synthetic-gt convention (no polygon masks in this pipeline yet):
-        the gt "mask" of a box is its full rectangle, so the target is the
-        intersection of the matched gt box with the roi, rasterized on the
-        roi's 28×28 grid.  Real datasets supply ``gt_masks`` through the
-        same hook once polygon decoding lands.
+        The matched gt is ``samples.gt_index`` — the SAME assignment
+        ``sample_rois`` derived the roi's label and bbox target from.
+        Re-deriving a fresh best-IoU argmax here could pair a roi
+        labeled class A with a mask cropped from a different
+        (higher-IoU) gt.
+
+        Targets: with ``gt_masks`` (B, G, M, M) box-frame bitmaps (real
+        polygon/RLE gts via ``data/masks.py``), each fg roi's target is
+        its matched bitmap bilinearly resampled under the roi grid and
+        binarized at 0.5.  Without (box-only datasets), the gt "mask"
+        is its full rectangle — ``rasterize_box_masks``.
         """
-        from mx_rcnn_tpu.ops.mask_targets import rasterize_box_masks
+        from mx_rcnn_tpu.ops.mask_targets import (
+            crop_resize_masks,
+            rasterize_box_masks,
+        )
 
         cfg = self.cfg
         b, r = samples.rois.shape[0], samples.rois.shape[1]
@@ -409,13 +419,20 @@ class FPNFasterRCNN(nn.Module):
         logits = self.mask_head(self._mask_pooled(pyramid, samples.rois))
         logits = logits.reshape(b, r, size, size, -1)
 
-        # target: matched gt box ∩ roi on the roi grid
         fg = samples.labels > 0                                   # (B, R)
-        targets = jax.vmap(
-            lambda rois_i, gtb, gtv: rasterize_box_masks(
-                rois_i, samples_matched_gt(rois_i, gtb, gtv), size
-            )
-        )(samples.rois, gt_boxes, gt_valid)                       # (B, R, S, S)
+        if gt_masks is None:
+            targets = jax.vmap(
+                lambda rois_i, gi, gtb: rasterize_box_masks(
+                    rois_i, gtb[gi, :4], size
+                )
+            )(samples.rois, samples.gt_index, gt_boxes)           # (B, R, S, S)
+        else:
+            soft = jax.vmap(
+                lambda rois_i, gi, gtb, gtm: crop_resize_masks(
+                    rois_i, gtb[gi, :4], gtm[gi], size
+                )
+            )(samples.rois, samples.gt_index, gt_boxes, gt_masks)
+            targets = (soft >= 0.5).astype(jnp.float32)
 
         cls = jnp.clip(samples.labels, 0)                         # (B, R)
         sel = jnp.take_along_axis(
@@ -425,15 +442,6 @@ class FPNFasterRCNN(nn.Module):
         per_roi = bce.mean(axis=(-1, -2))                         # (B, R)
         loss = (per_roi * fg).sum() / jnp.maximum(fg.sum(), 1.0)
         return loss, {"MaskBCELoss": loss}
-
-
-def samples_matched_gt(rois, gt_boxes, gt_valid):
-    """Best-IoU gt box per roi (the mask target source)."""
-    from mx_rcnn_tpu.ops.boxes import bbox_overlaps
-
-    ov = bbox_overlaps(rois, gt_boxes[:, :4])
-    ov = jnp.where(gt_valid[None, :], ov, -1.0)
-    return gt_boxes[ov.argmax(axis=1), :4]
 
 
 def optax_sigmoid_bce(logits, labels):

@@ -1,13 +1,18 @@
 """Mask target rasterization (Mask R-CNN extension).
 
 No reference twin (the MXNet reference has no mask path; SURVEY N5 covers
-only the eval-side RLE API).  Targets are produced fully in-graph on
-fixed shapes: for each roi, the matched gt region is rasterized onto the
-roi's S×S grid by cell-center inclusion testing — the box-mask special
-case of the general "crop gt mask to roi and resize" op.  Polygon/RLE gt
-masks plug in upstream by rasterizing to boxes' bitmaps on host and
-passing them through the same crop-resize (future work, gated on real
-COCO masks being on disk).
+only the eval-side RLE API — ``rcnn/pycocotools/maskApi.c`` lineage).
+Targets are produced fully in-graph on fixed shapes, from two sources:
+
+- ``rasterize_box_masks``: the box-mask special case (gt mask == gt
+  rectangle) used by box-only datasets — cell-center inclusion testing.
+- ``crop_resize_masks``: the general polygon/RLE path.  Host code
+  rasterizes each gt's polygons ONCE into a small gt-box-frame bitmap
+  (``data/masks.py``, M×M, default 64); in-graph, each roi's S×S target
+  is a bilinear resample of its matched gt bitmap under the roi grid.
+  The bilinear sample separates per axis, so the whole op is two small
+  matmuls per roi — (S, M) @ (M, M) @ (M, S) — batched over rois, which
+  XLA tiles straight onto the MXU instead of 2·S·S gathers.
 """
 
 from __future__ import annotations
@@ -35,3 +40,58 @@ def rasterize_box_masks(
     inside_x = (cx >= gt_boxes[:, None, 0]) & (cx <= gt_boxes[:, None, 2])
     inside_y = (cy >= gt_boxes[:, None, 1]) & (cy <= gt_boxes[:, None, 3])
     return (inside_y[:, :, None] & inside_x[:, None, :]).astype(jnp.float32)
+
+
+def _axis_weights(centers: jnp.ndarray, box_lo, box_span, m: int) -> jnp.ndarray:
+    """Bilinear weight matrix for one axis: (R, S) image-space cell
+    centers → (R, S, M) weights over the matched gt bitmap's M cells.
+
+    The gt bitmap covers the gt box ([lo, lo+span-1] in image pixels,
+    +1 convention) with M cells; a center maps to continuous bitmap
+    coordinate u ∈ [-0.5, M-0.5] and takes hat-function weights
+    relu(1 - |u - m|).  Centers outside the box fade to zero weight —
+    the zero-padding convention (nothing of the gt exists there).
+    """
+    u = (centers - box_lo[:, None]) / box_span[:, None] * m - 0.5   # (R, S)
+    idx = jnp.arange(m, dtype=jnp.float32)                          # (M,)
+    return jnp.maximum(0.0, 1.0 - jnp.abs(u[:, :, None] - idx))     # (R, S, M)
+
+
+def crop_resize_masks(
+    rois: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_masks: jnp.ndarray,
+    size: int,
+) -> jnp.ndarray:
+    """(R, 4) rois × (R, 4) matched gt boxes × (R, M, M) matched gt-frame
+    bitmaps → (R, S, S) soft targets in [0, 1].
+
+    ``gt_masks[r]`` is the r-th roi's matched gt rasterized over its OWN
+    box (row m covers the gt's y-extent, col n its x-extent — the
+    ``data/masks.py`` layout).  Each roi cell center is mapped into that
+    frame and bilinearly sampled; callers binarize at 0.5 (the standard
+    Mask R-CNN target convention).  All shapes static; everything is
+    batched matmuls.
+
+    Coordinates: boxes carry inclusive pixel indices (x2 = last pixel,
+    +1 width convention); the bitmap lives in CONTINUOUS space where
+    pixel p covers [p, p+1) — poly_fill's convention — so a roi cell
+    center's continuous coordinate is ``x1 + fr·w`` (its pixel-center
+    form ``x1 + fr·w − 0.5`` shifted by the half-pixel).
+    """
+    x1, y1, x2, y2 = rois[:, 0], rois[:, 1], rois[:, 2], rois[:, 3]
+    w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+    h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+    fr = (jnp.arange(size, dtype=jnp.float32) + 0.5) / size         # (S,)
+    cx = x1[:, None] + fr[None, :] * w[:, None]                     # (R, S)
+    cy = y1[:, None] + fr[None, :] * h[:, None]
+
+    gx1, gy1, gx2, gy2 = (gt_boxes[:, i] for i in range(4))
+    gw = jnp.maximum(gx2 - gx1 + 1.0, 1.0)
+    gh = jnp.maximum(gy2 - gy1 + 1.0, 1.0)
+    m = gt_masks.shape[-1]
+    wy = _axis_weights(cy, gy1, gh, m)                              # (R, S, M)
+    wx = _axis_weights(cx, gx1, gw, m)                              # (R, S, M)
+    masks = gt_masks.astype(jnp.float32)                            # (R, M, M)
+    rows = jnp.einsum("rym,rmn->ryn", wy, masks)                    # (R, S, M)
+    return jnp.einsum("ryn,rxn->ryx", rows, wx)                     # (R, S, S)

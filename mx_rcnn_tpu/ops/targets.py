@@ -46,6 +46,29 @@ def _random_keep_k(key, candidate_mask: jnp.ndarray, k) -> jnp.ndarray:
     return candidate_mask & (rank < k)
 
 
+def bbox_denorm_vectors(cfg: Config, num_classes: int):
+    """(4K,) de-normalization (means, stds) for test-time delta decode.
+
+    The per-class tables flatten class-major — exactly the 4K
+    class-specific layout ``sample_rois`` emits — so test forwards can
+    keep their single elementwise multiply-add regardless of whether
+    normalization was class-agnostic (end2end convention) or per-class
+    (``add_bbox_regression_targets`` precomputed-stats parity).
+    """
+    t = cfg.TRAIN
+    if t.BBOX_STDS_PER_CLASS is not None:
+        means = jnp.asarray(t.BBOX_MEANS_PER_CLASS, jnp.float32).reshape(-1)
+        stds = jnp.asarray(t.BBOX_STDS_PER_CLASS, jnp.float32).reshape(-1)
+        assert means.shape == (4 * num_classes,), (
+            f"per-class bbox stats shape {means.shape} != K={num_classes}"
+        )
+        return means, stds
+    return (
+        jnp.tile(jnp.asarray(t.BBOX_MEANS, jnp.float32), num_classes),
+        jnp.tile(jnp.asarray(t.BBOX_STDS, jnp.float32), num_classes),
+    )
+
+
 class AnchorTargets(NamedTuple):
     labels: jnp.ndarray        # (N,) int32: 1 fg / 0 bg / -1 ignore
     bbox_targets: jnp.ndarray  # (N, 4) float32
@@ -119,6 +142,10 @@ class RoiSamples(NamedTuple):
     labels: jnp.ndarray        # (R,) int32: class id, 0 = bg, -1 = ignore
     bbox_targets: jnp.ndarray  # (R, 4K) class-specific layout
     bbox_weights: jnp.ndarray  # (R, 4K)
+    gt_index: jnp.ndarray      # (R,) int32: matched gt slot (the SAME
+    #   assignment the label/bbox targets came from — mask targets must
+    #   reuse it, not re-derive a fresh best-IoU argmax, or a roi labeled
+    #   class A can be trained on a mask cropped from a different gt)
 
 
 def sample_rois(
@@ -185,11 +212,20 @@ def sample_rois(
         picked_fg, cls_of[idx], jnp.where(picked_bg, 0, -1)
     ).astype(jnp.int32)
 
-    # bbox regression targets, normalized then expanded to 4K layout
+    # bbox regression targets, normalized then expanded to 4K layout;
+    # per-class tables (the reference's precomputed-normalization path)
+    # override the class-agnostic vectors when present
     raw = bbox_transform(out_rois, gt_boxes[argmax_gt[idx], :4])
-    means = jnp.asarray(t.BBOX_MEANS, jnp.float32)
-    stds = jnp.asarray(t.BBOX_STDS, jnp.float32)
-    raw = (raw - means[None, :]) / stds[None, :]
+    if t.BBOX_STDS_PER_CLASS is not None:
+        means_t = jnp.asarray(t.BBOX_MEANS_PER_CLASS, jnp.float32)   # (K, 4)
+        stds_t = jnp.asarray(t.BBOX_STDS_PER_CLASS, jnp.float32)
+        means = means_t[jnp.clip(labels, 0)]                         # (R, 4)
+        stds = stds_t[jnp.clip(labels, 0)]
+        raw = (raw - means) / stds
+    else:
+        means = jnp.asarray(t.BBOX_MEANS, jnp.float32)
+        stds = jnp.asarray(t.BBOX_STDS, jnp.float32)
+        raw = (raw - means[None, :]) / stds[None, :]
     raw = jnp.where(picked_fg[:, None], raw, 0.0)
 
     cls_onehot = jax.nn.one_hot(
@@ -197,4 +233,7 @@ def sample_rois(
     ) * picked_fg[:, None]                                                # (R, K)
     bbox_targets = (cls_onehot[:, :, None] * raw[:, None, :]).reshape(r_out, -1)
     bbox_weights = jnp.repeat(cls_onehot, 4, axis=1)
-    return RoiSamples(out_rois, labels, bbox_targets, bbox_weights)
+    return RoiSamples(
+        out_rois, labels, bbox_targets, bbox_weights,
+        argmax_gt[idx].astype(jnp.int32),
+    )

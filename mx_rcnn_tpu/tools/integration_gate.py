@@ -7,10 +7,17 @@ synthetic images, then run the FULL inference + evaluation stack
 (Predictor → im_detect → per-class NMS → evaluate_detections) on the same
 images and demand the detections actually score.
 
-Usage:
-  python -m mx_rcnn_tpu.tools.integration_gate [--steps 400] [--target 0.8]
+``--network`` gates every model family: resnet50 (C4 flagship shape),
+resnet_fpn, mask_resnet_fpn, vgg.  The mask gate trains on synthetic
+POLYGON gts (ellipses/triangles — ``data/synthetic.py with_masks``) and
+must additionally reach segm AP50 ≥ target through the full mask stack
+(crop-resize targets → mask head → RLE paste → COCO segm protocol).
 
-Exit code 0 iff mAP ≥ target.  The pytest twin is
+Usage:
+  python -m mx_rcnn_tpu.tools.integration_gate [--network resnet50]
+      [--steps 400] [--target 0.8]
+
+Exit code 0 iff the gate metric ≥ target.  The pytest twin is
 ``tests/test_integration_gate.py``.
 """
 
@@ -30,26 +37,32 @@ from mx_rcnn_tpu.core.tester import Predictor, pred_eval
 from mx_rcnn_tpu.core.train import create_train_state, make_optimizer, make_train_step
 from mx_rcnn_tpu.data.loader import TestLoader, TrainLoader
 from mx_rcnn_tpu.data.synthetic import SyntheticDataset
-from mx_rcnn_tpu.models import FasterRCNN
+from mx_rcnn_tpu.models import build_model
 
 logger = logging.getLogger(__name__)
 
 
-def gate_cfg(num_classes: int = 4):
-    """Small-shape flagship-architecture config: resnet50 C4, one 128×128
-    bucket, reduced proposal/roi budgets for CPU-speed compiles."""
-    cfg = generate_config("resnet50", "PascalVOC")
-    return cfg.replace(
-        SHAPE_BUCKETS=((128, 128),),
-        # anchor sizes 32/64/128 px: the flagship scales (8, 16, 32) make
-        # anchors of 128-512 px, none of which fit inside a 128×128 image
-        # — every RPN label would be ignore and the RPN would never train.
+def gate_cfg(network: str = "resnet50", num_classes: int = 4):
+    """Small-shape config of the requested family: one 128×128 bucket,
+    reduced proposal/roi budgets for CPU-speed compiles."""
+    cfg = generate_config(network, "PascalVOC")
+    net_over = dict(
         # FIXED_PARAMS cleared: freezing conv0/stage1/BN affines only makes
         # sense with pretrained weights; frozen RANDOM features cap the
         # overfit capacity this gate measures.
-        network=dataclasses.replace(
-            cfg.network, ANCHOR_SCALES=(2, 4, 8), FIXED_PARAMS=()
-        ),
+        FIXED_PARAMS=(),
+    )
+    if not cfg.network.USE_FPN:
+        # anchor sizes 32/64/128 px: the flagship scales (8, 16, 32) make
+        # anchors of 128-512 px, none of which fit inside a 128×128 image
+        # — every RPN label would be ignore and the RPN would never train.
+        # (FPN keeps its per-level scale 8: P2/P3 anchors are 32/64 px.)
+        net_over["ANCHOR_SCALES"] = (2, 4, 8)
+    if cfg.network.depth > 50 and cfg.network.name == "resnet":
+        net_over["depth"] = 50  # mask registry defaults to 101; gate speed
+    return cfg.replace(
+        SHAPE_BUCKETS=((128, 128),),
+        network=dataclasses.replace(cfg.network, **net_over),
         dataset=dataclasses.replace(
             cfg.dataset, NUM_CLASSES=num_classes, SCALES=((128, 128),),
             MAX_GT_BOXES=8,
@@ -75,6 +88,7 @@ def gate_cfg(num_classes: int = 4):
 
 
 def run_gate(
+    network: str = "resnet50",
     num_images: int = 8,
     steps: int = 400,
     lr: float = 2e-3,
@@ -84,20 +98,23 @@ def run_gate(
 ) -> dict:
     """Train on ``num_images`` synthetic images, eval on the same images.
 
-    Returns {"mAP": best, "steps": steps_run, "per_eval": [(step, mAP)]}.
-    Stops early once ``target`` is reached.
+    Returns {"mAP": best, "gate": best_gate_metric, "steps": steps_run,
+    "per_eval": [(step, gate_metric)]}.  The gate metric is VOC mAP for
+    box models and min(mAP, segm AP50) for Mask R-CNN.  Stops early once
+    ``target`` is reached.
     """
-    cfg = gate_cfg()
+    cfg = gate_cfg(network)
     imdb = SyntheticDataset(
         num_images=num_images,
         num_classes=cfg.dataset.NUM_CLASSES,
         image_size=(128, 128),
         max_boxes=2,
         seed=seed,
+        with_masks=cfg.network.USE_MASK,
     )
     roidb = imdb.gt_roidb()
 
-    model = FasterRCNN(cfg)
+    model = build_model(cfg)
     loader = TrainLoader(
         roidb, cfg, cfg.TRAIN.BATCH_IMAGES, shuffle=True, seed=seed
     )
@@ -109,11 +126,8 @@ def run_gate(
     batch0 = next(iter(loader))
     params = model.init(
         {"params": jax.random.key(seed), "sampling": jax.random.key(seed + 1)},
-        batch0["images"],
-        batch0["im_info"],
-        batch0["gt_boxes"],
-        batch0["gt_valid"],
         train=True,
+        **batch0,
     )["params"]
     # 10x decay halfway: the constant-lr run overfits noisily (mAP
     # oscillates 0.4-0.7); the decayed tail lets it polish to convergence
@@ -124,15 +138,20 @@ def run_gate(
     step_fn = make_train_step(model, tx, donate=False)
     rng = jax.random.key(seed + 123)
 
-    def eval_map(state) -> float:
+    def eval_gate(state):
         predictor = Predictor(model, state.params)
         _, results = pred_eval(predictor, TestLoader(roidb, cfg), imdb, cfg)
         logger.info("per-class AP: %s",
                     {k: round(v, 3) for k, v in results.items()})
-        return float(results["mAP"])
+        m = float(results["mAP"])
+        if cfg.network.USE_MASK:
+            # the mask gate must prove SEGMENTATION quality, not ride on
+            # box mAP: min() forces both stacks to converge
+            return min(m, float(results.get("segm_AP50", 0.0))), results
+        return m, results
 
     per_eval = []
-    best = 0.0
+    best, best_results = 0.0, {}
     done = 0
     it = iter(loader)
     while done < steps:
@@ -145,13 +164,22 @@ def run_gate(
         done += 1
         if done % eval_every == 0 or done == steps:
             loss = float(aux["loss"])
-            m = eval_map(state)
+            m, results = eval_gate(state)
             per_eval.append((done, m))
-            best = max(best, m)
-            logger.info("step %d loss %.3f mAP %.3f", done, loss, m)
+            if m > best:
+                best, best_results = m, results
+            logger.info("step %d loss %.3f gate %.3f", done, loss, m)
             if best >= target:
                 break
-    return {"mAP": best, "steps": done, "per_eval": per_eval}
+    return {
+        "mAP": float(best_results.get("mAP", best)),
+        "segm_AP50": float(best_results["segm_AP50"])
+        if "segm_AP50" in best_results else None,
+        "gate": best,
+        "network": network,
+        "steps": done,
+        "per_eval": per_eval,
+    }
 
 
 def main():
@@ -159,6 +187,8 @@ def main():
 
     cli_bootstrap()
     p = argparse.ArgumentParser()
+    p.add_argument("--network", default="resnet50",
+                   choices=["resnet50", "resnet_fpn", "mask_resnet_fpn", "vgg"])
     p.add_argument("--steps", type=int, default=400)
     p.add_argument("--num_images", type=int, default=8)
     p.add_argument("--lr", type=float, default=2e-3)
@@ -171,6 +201,7 @@ def main():
 
         force_cpu(args.cpu)
     out = run_gate(
+        network=args.network,
         num_images=args.num_images,
         steps=args.steps,
         lr=args.lr,
@@ -178,7 +209,7 @@ def main():
         target=args.target,
     )
     print(out)
-    sys.exit(0 if out["mAP"] >= args.target else 1)
+    sys.exit(0 if out["gate"] >= args.target else 1)
 
 
 if __name__ == "__main__":

@@ -22,7 +22,12 @@ def get_imdb(cfg: Config, image_set: Optional[str] = None, synthetic_size: int =
         from mx_rcnn_tpu.data.synthetic import SyntheticDataset
 
         return [
-            SyntheticDataset(num_images=synthetic_size, num_classes=ds.NUM_CLASSES)
+            SyntheticDataset(
+                num_images=synthetic_size, num_classes=ds.NUM_CLASSES,
+                # Mask configs get polygon gts so the mask head trains on
+                # real (non-rectangular) shapes even in synthetic smokes
+                with_masks=cfg.network.USE_MASK,
+            )
         ]
     image_set = image_set or ds.image_set
     imdbs = []

@@ -1,0 +1,72 @@
+"""Round-4 scratch probe: mask-gate stability with frozen prefix vs lr."""
+import dataclasses
+import sys
+import time
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.train import create_train_state, make_optimizer, make_train_step
+from mx_rcnn_tpu.data.loader import TrainLoader
+from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+from mx_rcnn_tpu.models import build_model
+
+freeze = sys.argv[1] == "freeze" if len(sys.argv) > 1 else True
+lr = float(sys.argv[2]) if len(sys.argv) > 2 else 2e-3
+steps = int(sys.argv[3]) if len(sys.argv) > 3 else 60
+
+cfg = generate_config("mask_resnet_fpn", "PascalVOC")
+net_over = dict(depth=50)
+if not freeze:
+    net_over["FIXED_PARAMS"] = ()
+cfg = cfg.replace(
+    SHAPE_BUCKETS=((128, 128),),
+    network=dataclasses.replace(cfg.network, **net_over),
+    dataset=dataclasses.replace(
+        cfg.dataset, NUM_CLASSES=4, SCALES=((128, 128),), MAX_GT_BOXES=8
+    ),
+    TRAIN=dataclasses.replace(
+        cfg.TRAIN, RPN_PRE_NMS_TOP_N=400, RPN_POST_NMS_TOP_N=64,
+        BATCH_ROIS=32, RPN_BATCH_SIZE=64, BATCH_IMAGES=2, FLIP=False,
+    ),
+    TEST=dataclasses.replace(
+        cfg.TEST, RPN_PRE_NMS_TOP_N=200, RPN_POST_NMS_TOP_N=32,
+        SCORE_THRESH=0.05,
+    ),
+)
+imdb = SyntheticDataset(
+    num_images=8, num_classes=4, image_size=(128, 128), max_boxes=2,
+    seed=0, with_masks=True,
+)
+roidb = imdb.gt_roidb()
+model = build_model(cfg)
+loader = TrainLoader(roidb, cfg, cfg.TRAIN.BATCH_IMAGES, shuffle=True, seed=0)
+b0 = next(iter(loader))
+t0 = time.time()
+params = model.init(
+    {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+    train=True, **b0,
+)["params"]
+print("init done", round(time.time() - t0, 1), flush=True)
+tx = make_optimizer(cfg, lambda s: lr)
+state = create_train_state(params, tx)
+step = make_train_step(model, tx, donate=False)
+rng = jax.random.key(123)
+it = iter(loader)
+losses = []
+t0 = time.time()
+i = 0
+while i < steps:
+    try:
+        batch = next(it)
+    except StopIteration:
+        it = iter(loader)
+        continue
+    state, aux = step(state, batch, rng)
+    losses.append(float(aux["loss"]))
+    if i < 2 or i % 10 == 0:
+        print(i, round(time.time() - t0, 1), "s | loss", round(losses[-1], 2),
+              "mask", round(float(aux["MaskBCELoss"]), 3), flush=True)
+    i += 1
+print("last5", np.round(losses[-5:], 2), flush=True)
