@@ -37,7 +37,7 @@ from mx_rcnn_tpu.ops.losses import (
 )
 from mx_rcnn_tpu.ops.proposal import propose
 from mx_rcnn_tpu.ops.roi_align import extract_roi_features_batched
-from mx_rcnn_tpu.ops.targets import assign_anchor, sample_rois
+from mx_rcnn_tpu.ops.targets import assign_anchor, bbox_denorm_vectors, sample_rois
 
 
 def _dtype_of(cfg: Config):
@@ -244,8 +244,7 @@ class FasterRCNN(nn.Module):
         b, r = images.shape[0], te.RPN_POST_NMS_TOP_N
         k = cfg.dataset.NUM_CLASSES
 
-        means = jnp.tile(jnp.asarray(cfg.TRAIN.BBOX_MEANS, jnp.float32), k)
-        stds = jnp.tile(jnp.asarray(cfg.TRAIN.BBOX_STDS, jnp.float32), k)
+        means, stds = bbox_denorm_vectors(cfg, k)
         bbox_deltas = bbox_deltas * stds[None, :] + means[None, :]
 
         return {

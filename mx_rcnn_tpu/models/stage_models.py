@@ -35,7 +35,7 @@ from mx_rcnn_tpu.ops.anchors import shifted_anchors
 from mx_rcnn_tpu.ops.losses import accuracy, softmax_cross_entropy, weighted_smooth_l1
 from mx_rcnn_tpu.ops.proposal import propose
 from mx_rcnn_tpu.ops.roi_align import extract_roi_features_batched
-from mx_rcnn_tpu.ops.targets import assign_anchor, sample_rois
+from mx_rcnn_tpu.ops.targets import assign_anchor, bbox_denorm_vectors, sample_rois
 
 
 def _dtype_of(cfg: Config):
@@ -211,8 +211,7 @@ class FastRCNN(nn.Module):
             trunk = self._roi_features(feat, proposals)
             cls_logits, bbox_deltas = self.rcnn(trunk)
             r = proposals.shape[1]
-            means = jnp.tile(jnp.asarray(t.BBOX_MEANS, jnp.float32), k)
-            stds = jnp.tile(jnp.asarray(t.BBOX_STDS, jnp.float32), k)
+            means, stds = bbox_denorm_vectors(cfg, k)
             bbox_deltas = bbox_deltas * stds[None, :] + means[None, :]
             return {
                 "rois": proposals,

@@ -35,13 +35,21 @@ def train_rcnn(
 ) -> tuple[Dict, Config]:
     """Train Fast-RCNN on a proposal roidb; returns (params, cfg_used).
 
-    The returned config carries the roidb-precomputed BBOX_MEANS/STDS
-    (needed at eval time to de-normalize deltas consistently)."""
+    The returned config carries the roidb-precomputed per-class
+    BBOX_MEANS/STDS tables (the reference ``add_bbox_regression_targets``
+    semantics; needed at eval time to de-normalize deltas consistently)."""
     if cfg.TRAIN.BBOX_NORMALIZATION_PRECOMPUTED:
-        means, stds = compute_bbox_stats(proposal_roidb, cfg)
-        logger.info("bbox target stats: means=%s stds=%s", means, stds)
+        means, stds = compute_bbox_stats(proposal_roidb, cfg, per_class=True)
+        logger.info(
+            "per-class bbox target stats: fg classes=%d",
+            sum(1 for row in stds if tuple(row) != tuple(cfg.TRAIN.BBOX_STDS)),
+        )
         cfg = cfg.replace(
-            TRAIN=dataclasses.replace(cfg.TRAIN, BBOX_MEANS=means, BBOX_STDS=stds)
+            TRAIN=dataclasses.replace(
+                cfg.TRAIN,
+                BBOX_MEANS_PER_CLASS=means,
+                BBOX_STDS_PER_CLASS=stds,
+            )
         )
     fixed = cfg.network.FIXED_PARAMS_SHARED if frozen_shared else None
     model = FastRCNN(cfg, fixed_params=fixed)

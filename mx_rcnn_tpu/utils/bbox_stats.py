@@ -8,10 +8,11 @@ by their dataset-wide mean/std (``TRAIN.BBOX_NORMALIZATION_PRECOMPUTED``).
 
 The TPU rebuild keeps normalization *in-graph* (``ops/targets.py ::
 sample_rois`` applies cfg BBOX_MEANS/STDS), so the precompute returns the
-stats for a config override rather than mutating the roidb.  Deviation
-from the reference, documented: stats are class-agnostic (one (4,)
-mean/std) — the in-graph normalizer is class-agnostic, matching the
-end2end mode's fixed (0.1, 0.1, 0.2, 0.2) stds convention.
+stats for a config override rather than mutating the roidb.
+``compute_bbox_stats(..., per_class=True)`` matches the reference's
+per-class (K, 4) tables (classes without fg samples fall back to the
+class-agnostic defaults); ``per_class=False`` keeps the class-agnostic
+(4,) variant used by the end2end fixed-stds convention.
 """
 
 from __future__ import annotations
@@ -114,15 +115,20 @@ def np_transform(ex: np.ndarray, gt: np.ndarray) -> np.ndarray:
 
 
 def compute_bbox_stats(
-    roidb: List[Dict], cfg: Config
-) -> Tuple[Tuple[float, ...], Tuple[float, ...]]:
+    roidb: List[Dict], cfg: Config, per_class: bool = False
+) -> Tuple[Tuple, Tuple]:
     """(means, stds) of fg proposal→gt deltas across a proposal roidb.
 
     fg = proposals with best-gt IoU ≥ TRAIN.BBOX_REGRESSION_THRESH.
+    ``per_class=False``: one (4,) pair over all fg deltas.
+    ``per_class=True``: (K, 4) tables keyed by the matched gt's class —
+    the reference ``add_bbox_regression_targets`` semantics; class 0
+    (background, never regressed) and classes without fg samples carry
+    the class-agnostic config defaults.
     Falls back to the config defaults when the roidb has no fg pairs.
     """
     thresh = cfg.TRAIN.BBOX_REGRESSION_THRESH
-    acc = []
+    acc, cls_acc = [], []
     for rec in roidb:
         props = np.asarray(rec.get("proposals", ()), np.float32)
         gts = np.asarray(rec["boxes"], np.float32)
@@ -134,9 +140,33 @@ def compute_bbox_stats(
         fg = best >= thresh
         if fg.any():
             acc.append(np_transform(props[fg], gts[arg[fg]]))
+            cls_acc.append(
+                np.asarray(rec["gt_classes"], np.int64)[arg[fg]]
+            )
     if not acc:
+        if per_class:
+            k = cfg.dataset.NUM_CLASSES
+            return (
+                tuple(tuple(cfg.TRAIN.BBOX_MEANS) for _ in range(k)),
+                tuple(tuple(cfg.TRAIN.BBOX_STDS) for _ in range(k)),
+            )
         return cfg.TRAIN.BBOX_MEANS, cfg.TRAIN.BBOX_STDS
     deltas = np.concatenate(acc, axis=0)
-    means = deltas.mean(axis=0)
-    stds = deltas.std(axis=0) + 1e-8
-    return tuple(float(x) for x in means), tuple(float(x) for x in stds)
+    if not per_class:
+        means = deltas.mean(axis=0)
+        stds = deltas.std(axis=0) + 1e-8
+        return tuple(float(x) for x in means), tuple(float(x) for x in stds)
+
+    classes = np.concatenate(cls_acc, axis=0)
+    k = cfg.dataset.NUM_CLASSES
+    means = np.tile(np.asarray(cfg.TRAIN.BBOX_MEANS, np.float64), (k, 1))
+    stds = np.tile(np.asarray(cfg.TRAIN.BBOX_STDS, np.float64), (k, 1))
+    for c in range(1, k):
+        sel = deltas[classes == c]
+        if len(sel):
+            means[c] = sel.mean(axis=0)
+            stds[c] = sel.std(axis=0) + 1e-8
+    return (
+        tuple(tuple(float(x) for x in row) for row in means),
+        tuple(tuple(float(x) for x in row) for row in stds),
+    )

@@ -40,6 +40,13 @@ def save_run_meta(prefix_or_file: str, cfg: Config) -> str:
         "BBOX_STDS": list(cfg.TRAIN.BBOX_STDS),
         "COMPUTE_DTYPE": cfg.network.COMPUTE_DTYPE,
     }
+    if cfg.TRAIN.BBOX_STDS_PER_CLASS is not None:
+        meta["BBOX_MEANS_PER_CLASS"] = [
+            list(row) for row in cfg.TRAIN.BBOX_MEANS_PER_CLASS
+        ]
+        meta["BBOX_STDS_PER_CLASS"] = [
+            list(row) for row in cfg.TRAIN.BBOX_STDS_PER_CLASS
+        ]
     with open(path, "w") as f:
         json.dump(meta, f, indent=1)
     return path
@@ -66,5 +73,13 @@ def apply_run_meta(cfg: Config, meta: Optional[Dict]) -> Config:
         cfg.TRAIN,
         BBOX_MEANS=tuple(meta["BBOX_MEANS"]),
         BBOX_STDS=tuple(meta["BBOX_STDS"]),
+        BBOX_MEANS_PER_CLASS=(
+            tuple(tuple(r) for r in meta["BBOX_MEANS_PER_CLASS"])
+            if "BBOX_MEANS_PER_CLASS" in meta else None
+        ),
+        BBOX_STDS_PER_CLASS=(
+            tuple(tuple(r) for r in meta["BBOX_STDS_PER_CLASS"])
+            if "BBOX_STDS_PER_CLASS" in meta else None
+        ),
     )
     return cfg.replace(network=net, TRAIN=train)

@@ -128,6 +128,91 @@ class TestBboxStats:
         assert means == cfg.TRAIN.BBOX_MEANS
         assert stds == cfg.TRAIN.BBOX_STDS
 
+    def test_per_class_stats_separate_distributions(self):
+        """Class 1 proposals systematically offset +dx, class 2 offset
+        +dy: the per-class means must disentangle what the agnostic
+        means blend, and untouched classes keep the config defaults."""
+        cfg = tiny_alt_cfg()
+        rng = np.random.RandomState(0)
+        roidb = []
+        for _ in range(8):
+            boxes, classes, props = [], [], []
+            # class regions far apart so proposals can only match their
+            # own class's gt
+            for cls, (ox, oy), x_base in (
+                (1, (10.0, 0.0), 20), (2, (0.0, 10.0), 220)
+            ):
+                x1 = float(rng.randint(x_base, x_base + 40))
+                y1 = float(rng.randint(20, 60))
+                w = h = 40.0
+                boxes.append([x1, y1, x1 + w, y1 + h])
+                classes.append(cls)
+                props.append([x1 - ox, y1 - oy, x1 + w - ox, y1 + h - oy])
+            roidb.append({
+                "boxes": np.asarray(boxes, np.float32),
+                "gt_classes": np.asarray(classes, np.int32),
+                "proposals": np.asarray(props, np.float32),
+            })
+        means, stds = compute_bbox_stats(roidb, cfg, per_class=True)
+        k = cfg.dataset.NUM_CLASSES
+        assert len(means) == k and len(stds) == k
+        assert means[1][0] > 0.2 and abs(means[1][1]) < 1e-5  # dx offset
+        assert means[2][1] > 0.2 and abs(means[2][0]) < 1e-5  # dy offset
+        assert means[0] == tuple(cfg.TRAIN.BBOX_MEANS)  # bg untouched
+        # class 3 has no samples → defaults
+        assert stds[3] == tuple(cfg.TRAIN.BBOX_STDS)
+
+    def test_per_class_normalization_roundtrips_through_denorm(self):
+        """sample_rois normalized with per-class tables, then
+        bbox_denorm_vectors de-normalization, must reproduce the raw
+        proposal→gt deltas exactly — the train→eval consistency the
+        Fast-RCNN precomputed-stats mode depends on."""
+        import dataclasses as dc
+
+        import jax
+        import jax.numpy as jnp
+
+        from mx_rcnn_tpu.ops.targets import bbox_denorm_vectors, sample_rois
+        from mx_rcnn_tpu.utils.bbox_stats import np_transform
+
+        cfg = tiny_alt_cfg()
+        k = cfg.dataset.NUM_CLASSES
+        rng = np.random.RandomState(1)
+        means = tuple(
+            tuple(float(v) for v in rng.uniform(-0.2, 0.2, 4)) for _ in range(k)
+        )
+        stds = tuple(
+            tuple(float(v) for v in rng.uniform(0.05, 0.4, 4)) for _ in range(k)
+        )
+        cfg = cfg.replace(TRAIN=dc.replace(
+            cfg.TRAIN, BBOX_MEANS_PER_CLASS=means, BBOX_STDS_PER_CLASS=stds,
+            BATCH_ROIS=16,
+        ))
+        gt = np.asarray(
+            [[20, 20, 80, 90, 1], [100, 40, 180, 110, 2]], np.float32
+        )
+        props = np.concatenate(
+            [gt[:, :4] + rng.randint(-10, 10, (2, 4)),
+             gt[:, :4] + rng.randint(-10, 10, (2, 4))]
+        ).astype(np.float32)
+        s = sample_rois(
+            jnp.asarray(props), jnp.ones((4,), bool),
+            jnp.asarray(gt), jnp.ones((2,), bool),
+            jax.random.key(0), cfg,
+        )
+        labels = np.asarray(s.labels)
+        rois = np.asarray(s.rois)
+        tgts = np.asarray(s.bbox_targets).reshape(len(labels), k, 4)
+        dmeans, dstds = (np.asarray(v).reshape(k, 4)
+                         for v in bbox_denorm_vectors(cfg, k))
+        gidx = np.asarray(s.gt_index)
+        for i, c in enumerate(labels):
+            if c <= 0:
+                continue
+            denorm = tgts[i, c] * dstds[c] + dmeans[c]
+            raw = np_transform(rois[i:i + 1], gt[gidx[i]:gidx[i] + 1, :4])[0]
+            np.testing.assert_allclose(denorm, raw, rtol=1e-4, atol=1e-5)
+
 
 class TestProposalRoidbChain:
     def test_dump_load_roundtrip(self, tiny_roidb, tmp_path):

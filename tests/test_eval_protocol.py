@@ -1,0 +1,309 @@
+"""Adversarial golden tests for the reimplemented COCO / VOC eval
+protocols (VERDICT r3 #4).
+
+Every expected value below was derived BY HAND from the published
+protocol semantics (the vendored pycocotools ``cocoeval.py`` rules and
+the canonical VOC ``voc_eval``), independently of this repo's
+implementation — each test pins one rule whose silent drift would
+corrupt reported mAP:
+
+- crowd gts absorb multiple detections as ignores (never FPs),
+- unmatched detections outside the area range are ignored,
+- maxDets 1/10/100 per-image slicing,
+- equal-score detections keep insertion order (stable/mergesort sort),
+- a regular-gt match (any IoU ≥ thr) outranks a higher-IoU ignored gt,
+- segm IoU diverges from bbox IoU on same-box different-mask shapes,
+- VOC difficult boxes are neither TP nor FP and leave npos,
+- VOC 07 11-point vs integral metric divergence,
+- VOC strict ``IoU > thresh`` (exactly-at-threshold is NOT a match).
+"""
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.eval.coco_eval import COCOEvalBbox
+from mx_rcnn_tpu.eval.voc_eval import voc_eval
+from mx_rcnn_tpu.native import rle as rlelib
+
+
+def make_dataset(images, annotations, num_cats: int = 1):
+    return {
+        "images": [
+            {"id": i, "height": h, "width": w} for i, (h, w) in images.items()
+        ],
+        "annotations": [
+            dict(ann, id=k + 1) for k, ann in enumerate(annotations)
+        ],
+        "categories": [{"id": c + 1, "name": f"c{c + 1}"} for c in range(num_cats)],
+    }
+
+
+def ann(img, box, cat=1, crowd=0, area=None, segm=None):
+    out = {
+        "image_id": img,
+        "category_id": cat,
+        "bbox": list(box),
+        "iscrowd": crowd,
+        "area": float(area if area is not None else box[2] * box[3]),
+    }
+    if segm is not None:
+        out["segmentation"] = segm
+    return out
+
+
+def det(img, box, score, cat=1, segm=None):
+    out = {
+        "image_id": img,
+        "category_id": cat,
+        "bbox": list(box),
+        "score": score,
+    }
+    if segm is not None:
+        out["segmentation"] = segm
+    return out
+
+
+class TestCrowdAbsorption:
+    def test_crowd_absorbs_multiple_dets_as_ignores(self):
+        """Two high-scoring dets inside a crowd region must be ignored
+        (crowd IoU = inter/det_area = 1.0), NOT become FPs ahead of the
+        real TP.  Hand derivation: the only counted gt is the normal one
+        on image 1; its det matches at IoU 1 → precision 1 at recall 1 →
+        AP = 1.0 at every threshold.  Without crowd absorption the two
+        score-0.9/0.8 FPs would drag AP to 1/3."""
+        ds = make_dataset(
+            {0: (100, 100), 1: (100, 100)},
+            [
+                ann(0, [0, 0, 50, 50], crowd=1),
+                ann(1, [0, 0, 30, 30]),
+            ],
+        )
+        results = [
+            det(0, [0, 0, 50, 50], 0.9),
+            det(0, [5, 5, 40, 40], 0.8),
+            det(1, [0, 0, 30, 30], 0.7),
+        ]
+        stats = COCOEvalBbox(ds, results).evaluate(verbose=False)
+        assert stats["AP"] == pytest.approx(1.0)
+        assert stats["AR_100"] == pytest.approx(1.0)
+
+
+class TestAreaRangeIgnore:
+    def test_unmatched_large_det_ignored_in_small_range(self):
+        """gt 20×20 (area 400, 'small'); det A = exact match (0.8); det B
+        40000-area no-overlap FP with HIGHER score (0.9).
+
+        All-range (hand): order [B(FP), A(TP)] → precision at recall 1 is
+        1/2, envelope 0.5 everywhere → AP = 0.5 at every threshold.
+        Small-range: B is unmatched AND out of (0, 32²] → ignored, so
+        precision stays 1 → AP_small = 1.0.  An implementation that
+        forgot the unmatched-out-of-range ignore would report 0.5."""
+        ds = make_dataset(
+            {0: (300, 300)},
+            [ann(0, [0, 0, 20, 20])],
+        )
+        results = [
+            det(0, [100, 100, 200, 200], 0.9),
+            det(0, [0, 0, 20, 20], 0.8),
+        ]
+        stats = COCOEvalBbox(ds, results).evaluate(verbose=False)
+        assert stats["AP"] == pytest.approx(0.5)
+        assert stats["AP50"] == pytest.approx(0.5)
+        assert stats["AP_small"] == pytest.approx(1.0)
+        # no medium/large gt → those stats are the -1 sentinel
+        assert stats["AP_medium"] == -1.0
+        assert stats["AP_large"] == -1.0
+
+
+class TestMaxDetsSlicing:
+    def test_ar_1_10_100(self):
+        """12 disjoint gts, each matched by one det (scores descending):
+        AR_1 = 1/12, AR_10 = 10/12, AR_100 = 1, AP = 1 (no FPs)."""
+        boxes = [[(i % 4) * 70, (i // 4) * 70, 30, 30] for i in range(12)]
+        ds = make_dataset(
+            {0: (300, 300)},
+            [ann(0, b) for b in boxes],
+        )
+        results = [
+            det(0, b, 0.99 - 0.01 * i) for i, b in enumerate(boxes)
+        ]
+        stats = COCOEvalBbox(ds, results).evaluate(verbose=False)
+        assert stats["AR_1"] == pytest.approx(1 / 12)
+        assert stats["AR_10"] == pytest.approx(10 / 12)
+        assert stats["AR_100"] == pytest.approx(1.0)
+        assert stats["AP"] == pytest.approx(1.0)
+
+
+class TestEqualScoreOrdering:
+    def test_ties_keep_insertion_order(self):
+        """pycocotools sorts with mergesort (stable): two dets at the
+        same score keep their listed order.  Listed [FP, TP] at score
+        0.5 → precision at recall 1 is 1/2 → AP = 0.5.  An unstable sort
+        that flipped them would yield 1.0."""
+        ds = make_dataset({0: (300, 300)}, [ann(0, [0, 0, 30, 30])])
+        results = [
+            det(0, [200, 200, 30, 30], 0.5),   # FP, listed first
+            det(0, [0, 0, 30, 30], 0.5),       # TP, same score
+        ]
+        stats = COCOEvalBbox(ds, results).evaluate(verbose=False)
+        assert stats["AP"] == pytest.approx(0.5)
+
+
+class TestRegularGtPriority:
+    def test_regular_match_beats_higher_iou_ignored_gt(self):
+        """Small range: gt S (32×32, area 1024 — exactly in range) and
+        gt B (34×66, area 2244 — ignored).  det 32×64: IoU(S) = 0.5,
+        IoU(B) = 2048/2244 ≈ 0.913.
+
+        Hand sweep over the 10 thresholds (small range, npig = 1):
+        t = 0.50 → S is a candidate; the REGULAR match must win over the
+        higher-IoU ignored B → TP → AP(t) = 1.
+        t = 0.55 … 0.90 → S fails, det matches ignored B → ignored (not
+        FP) → recall 0 → AP(t) = 0.
+        t = 0.95 → unmatched; det area 2048 > 1024 → ignored → AP(t)=0.
+        AP_small = 1/10.  Preferring the ignored gt at t=0.5 would give
+        0; counting FPs at mid thresholds would also break the 0.1.
+
+        All range (both gts regular, npig = 2): det matches B (max IoU)
+        for t ≤ 0.90 → recall 0.5, precision 1 → AP(t) = 51/101; at
+        t = 0.95 → unmatched, in range → FP → AP(t) = 0.
+        AP = 9 × (51/101) / 10."""
+        ds = make_dataset(
+            {0: (300, 300)},
+            [
+                ann(0, [0, 0, 32, 32], area=1024.0),
+                ann(0, [0, 0, 34, 66], area=2244.0),
+            ],
+        )
+        results = [det(0, [0, 0, 32, 64], 0.9)]
+        stats = COCOEvalBbox(ds, results).evaluate(verbose=False)
+        assert stats["AP_small"] == pytest.approx(0.1)
+        assert stats["AP"] == pytest.approx(9 * (51 / 101) / 10)
+
+
+class TestSegmVsBboxIoU:
+    def test_same_bbox_different_mask_diverges(self):
+        """gt = left half of a 20×20 image (polygon), det carries the gt's
+        exact bbox but the RIGHT-half mask: bbox protocol scores AP 1.0,
+        segm protocol sees mask IoU 0 → AP 0.0."""
+        left_poly = [[0.0, 0.0, 10.0, 0.0, 10.0, 20.0, 0.0, 20.0]]
+        right = np.zeros((20, 20), np.uint8)
+        right[:, 10:] = 1
+        ds = make_dataset(
+            {0: (20, 20)},
+            [ann(0, [0, 0, 10, 20], segm=left_poly)],
+        )
+        results = [
+            det(0, [0, 0, 10, 20], 0.9, segm=rlelib.encode(right))
+        ]
+        bbox_stats = COCOEvalBbox(ds, results).evaluate(verbose=False)
+        segm_stats = COCOEvalBbox(ds, results, iou_type="segm").evaluate(
+            verbose=False
+        )
+        assert bbox_stats["AP"] == pytest.approx(1.0)
+        assert segm_stats["AP"] == pytest.approx(0.0)
+
+    def test_segm_exact_match_scores_one(self):
+        """Control for the divergence test: the det carrying the gt's own
+        mask scores segm AP 1.0."""
+        left_poly = [[0.0, 0.0, 10.0, 0.0, 10.0, 20.0, 0.0, 20.0]]
+        left = np.zeros((20, 20), np.uint8)
+        left[:, :10] = 1
+        ds = make_dataset(
+            {0: (20, 20)},
+            [ann(0, [0, 0, 10, 20], segm=left_poly)],
+        )
+        results = [det(0, [0, 0, 10, 20], 0.9, segm=rlelib.encode(left))]
+        stats = COCOEvalBbox(ds, results, iou_type="segm").evaluate(
+            verbose=False
+        )
+        assert stats["AP"] == pytest.approx(1.0)
+
+
+class TestVOCProtocol:
+    def test_difficult_neither_tp_nor_fp(self):
+        """Det on a difficult gt is skipped entirely (not TP, not FP) and
+        difficult gts leave npos: the remaining exact match gives AP 1.0.
+        Counting the difficult det as FP (or its gt in npos) would give
+        0.5 — the two classic drift bugs."""
+        annots = {
+            0: {
+                "boxes": np.asarray(
+                    [[0, 0, 30, 30], [100, 100, 130, 130]], np.float32
+                ),
+                "gt_classes": np.asarray([1, 1], np.int32),
+                "difficult": np.asarray([False, True]),
+            }
+        }
+        dets = {
+            0: np.asarray(
+                [[100, 100, 130, 130, 0.9], [0, 0, 30, 30, 0.8]], np.float32
+            )
+        }
+        _, _, ap = voc_eval(dets, annots, 1, 0.5, use_07_metric=False)
+        assert ap == pytest.approx(1.0)
+
+    def test_07_vs_integral_metric(self):
+        """2 gts; dets TP(0.9), FP(0.8), TP(0.7) → rec [.5, .5, 1],
+        prec [1, .5, 2/3].
+        Integral (hand): 0.5·1 + 0.5·(2/3) = 5/6.
+        11-point (hand): 6 points (t ≤ .5) at 1 + 5 points at 2/3 →
+        (6 + 10/3)/11 = 28/33."""
+        annots = {
+            0: {
+                "boxes": np.asarray(
+                    [[0, 0, 30, 30], [100, 100, 130, 130]], np.float32
+                ),
+                "gt_classes": np.asarray([1, 1], np.int32),
+            }
+        }
+        dets = {
+            0: np.asarray(
+                [
+                    [0, 0, 30, 30, 0.9],        # TP
+                    [200, 200, 230, 230, 0.8],  # FP
+                    [100, 100, 130, 130, 0.7],  # TP
+                ],
+                np.float32,
+            )
+        }
+        _, _, ap_int = voc_eval(dets, annots, 1, 0.5, use_07_metric=False)
+        _, _, ap_07 = voc_eval(dets, annots, 1, 0.5, use_07_metric=True)
+        assert ap_int == pytest.approx(5 / 6)
+        assert ap_07 == pytest.approx(28 / 33)
+
+    def test_exactly_at_threshold_is_not_a_match(self):
+        """The canonical voc_eval tests ``ovmax > ovthresh`` STRICTLY: a
+        det at IoU exactly 0.5 (gt 10×20 inside a 10×40 det) is an FP →
+        AP 0.  An >= implementation would score 1.0."""
+        annots = {
+            0: {
+                "boxes": np.asarray([[0, 0, 9, 19]], np.float32),
+                "gt_classes": np.asarray([1], np.int32),
+            }
+        }
+        dets = {0: np.asarray([[0, 0, 9, 39, 0.9]], np.float32)}
+        _, _, ap = voc_eval(dets, annots, 1, 0.5, use_07_metric=False)
+        assert ap == pytest.approx(0.0)
+
+    def test_double_detection_is_fp(self):
+        """Second det on an already-matched gt is an FP (greedy
+        one-to-one): dets exact(0.9) + exact(0.8) on one gt →
+        rec [1, 1], prec [1, .5] → integral AP = 1.0 (envelope takes
+        precision at first recall step)… so assert the PR curve
+        directly, where the duplicate shows as fp[1] = 1."""
+        annots = {
+            0: {
+                "boxes": np.asarray([[0, 0, 30, 30]], np.float32),
+                "gt_classes": np.asarray([1], np.int32),
+            }
+        }
+        dets = {
+            0: np.asarray(
+                [[0, 0, 30, 30, 0.9], [1, 1, 31, 31, 0.8]], np.float32
+            )
+        }
+        rec, prec, ap = voc_eval(dets, annots, 1, 0.5, use_07_metric=False)
+        np.testing.assert_allclose(rec, [1.0, 1.0])
+        np.testing.assert_allclose(prec, [1.0, 0.5])
+        assert ap == pytest.approx(1.0)
