@@ -31,17 +31,33 @@ logger = logging.getLogger(__name__)
 class Predictor:
     """Jitted forward-only wrapper (Predictor twin).  One compile per
     shape bucket — the TPU replacement for MutableModule max-shape
-    binding."""
+    binding.
 
-    def __init__(self, model, params):
+    ``postprocess`` (ops/postprocess.py): fuses per-class decode+NMS
+    into the same jit, so only keep lists cross the device→host link
+    instead of the full (B, R, K)+(B, R, 4K) head outputs.  Mask models
+    skip it automatically (mask pasting needs full outputs on host)."""
+
+    def __init__(self, model, params, postprocess=None):
         self.model = model
         self.params = params
+
         # batch keys match the model __call__ kwargs (gt keys are accepted
         # and ignored by test forwards; FastRCNN additionally consumes
         # proposals/prop_valid)
-        self._fn = jax.jit(
-            lambda p, batch: model.apply({"params": p}, train=False, **batch)
-        )
+        def fwd(p, batch):
+            batch = dict(batch)
+            orig_hw = batch.pop("orig_hw", None)
+            out = model.apply({"params": p}, train=False, **batch)
+            if (
+                postprocess is not None
+                and orig_hw is not None
+                and "mask_logits" not in out
+            ):
+                return postprocess(out, batch["im_info"], orig_hw)
+            return out
+
+        self._fn = jax.jit(fwd)
 
     def predict(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         out = self._fn(self.params, batch)
@@ -102,6 +118,16 @@ def pred_eval(
     thresh = te.SCORE_THRESH if thresh is None else thresh
     num_classes = imdb.num_classes
     num_images = len(loader)
+    if te.DEVICE_POSTPROCESS:
+        from mx_rcnn_tpu.ops.postprocess import make_test_postprocess
+
+        predictor = Predictor(
+            predictor.model,
+            predictor.params,
+            postprocess=make_test_postprocess(
+                cfg, num_classes, thresh, max_out=te.DET_PER_CLASS
+            ),
+        )
     all_boxes: List[List[np.ndarray]] = [
         [np.zeros((0, 5), np.float32) for _ in range(num_images)]
         for _ in range(num_classes)
@@ -114,23 +140,36 @@ def pred_eval(
         """Accumulate detections for dataset image ``i`` from the
         ``k``-th slot of a (possibly batched) forward's outputs."""
         nonlocal all_masks, done
-        det = im_detect(
-            out, batch["im_info"][k], (rec["height"], rec["width"]), index=k
-        )
-        scores, boxes = det["scores"], det["boxes"]
-        with_masks = "mask_probs" in det
-        if with_masks and all_masks is None:
-            all_masks = [[[] for _ in range(num_images)] for _ in range(num_classes)]
+        with_masks = False
         mask_probs: Dict[int, np.ndarray] = {}
-        for j in range(1, num_classes):
-            keep = np.where(scores[:, j] > thresh)[0]
-            cls_dets = np.hstack(
-                [boxes[keep, j * 4 : (j + 1) * 4], scores[keep, j : j + 1]]
-            ).astype(np.float32)
-            keep_nms = nms_host(cls_dets, te.NMS)
-            all_boxes[j][i] = cls_dets[keep_nms]
-            if with_masks:
-                mask_probs[j] = det["mask_probs"][keep][keep_nms, :, :, j]
+        if "det_boxes" in out:
+            # device postprocess path: decode, unscale, clip, and
+            # per-class NMS all ran in the forward jit; boxes arrive in
+            # original image coordinates
+            for j in range(1, num_classes):
+                m = out["det_valid"][k][j - 1].astype(bool)
+                b = np.asarray(out["det_boxes"][k][j - 1][m])
+                s = np.asarray(out["det_scores"][k][j - 1][m])
+                all_boxes[j][i] = np.hstack([b, s[:, None]]).astype(np.float32)
+        else:
+            det = im_detect(
+                out, batch["im_info"][k], (rec["height"], rec["width"]), index=k
+            )
+            scores, boxes = det["scores"], det["boxes"]
+            with_masks = "mask_probs" in det
+            if with_masks and all_masks is None:
+                all_masks = [
+                    [[] for _ in range(num_images)] for _ in range(num_classes)
+                ]
+            for j in range(1, num_classes):
+                keep = np.where(scores[:, j] > thresh)[0]
+                cls_dets = np.hstack(
+                    [boxes[keep, j * 4 : (j + 1) * 4], scores[keep, j : j + 1]]
+                ).astype(np.float32)
+                keep_nms = nms_host(cls_dets, te.NMS)
+                all_boxes[j][i] = cls_dets[keep_nms]
+                if with_masks:
+                    mask_probs[j] = det["mask_probs"][keep][keep_nms, :, :, j]
         # cap detections per image across classes (COCO: 100)
         if te.MAX_PER_IMAGE > 0:
             all_scores = np.concatenate(

@@ -89,13 +89,21 @@ def prepare_image(
     pixel_means,
     pixel_stds,
     buckets: Sequence[Tuple[int, int]],
+    uint8_out: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Full per-image path: resize → normalize → bucket-pad.
 
     Returns (padded image, im_info=(resized_h, resized_w, scale)).
+
+    ``uint8_out`` skips host normalization and emits rounded uint8 RGB
+    (TEST.UINT8_TRANSFER: 4× less host→device traffic; the model
+    normalizes on device — a ≤0.5-LSB quantization of resized pixels).
     """
     im, scale = resize_im(im, target_size, max_size)
     h, w = im.shape[:2]
-    im = normalize(im, pixel_means, pixel_stds)
+    if uint8_out:
+        im = np.clip(np.rint(im), 0, 255).astype(np.uint8)
+    else:
+        im = normalize(im, pixel_means, pixel_stds)
     im = pad_to_bucket(im, pick_bucket(h, w, buckets))
     return im, np.array([h, w, scale], np.float32)

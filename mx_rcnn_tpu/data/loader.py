@@ -52,6 +52,7 @@ def make_batch(
     proposal_count: int = 0,
     seeds: Optional[Sequence[int]] = None,
     with_masks: bool = False,
+    uint8_images: bool = False,
 ) -> Dict[str, np.ndarray]:
     """Assemble one padded train batch from roidb records.
 
@@ -73,7 +74,9 @@ def make_batch(
     g = cfg.dataset.MAX_GT_BOXES
     n = len(records)
     bh, bw = bucket
-    out_images = np.zeros((n, bh, bw, 3), np.float32)
+    out_images = np.zeros(
+        (n, bh, bw, 3), np.uint8 if uint8_images else np.float32
+    )
     im_info = np.zeros((n, 3), np.float32)
     gt_boxes = np.zeros((n, g, 5), np.float32)
     gt_valid = np.zeros((n, g), bool)
@@ -94,6 +97,7 @@ def make_batch(
             cfg.network.PIXEL_MEANS,
             cfg.network.PIXEL_STDS,
             [bucket],
+            uint8_out=uint8_images,
         )
         out_images[i] = padded
         im_info[i] = info
@@ -292,7 +296,11 @@ class TestLoader:
         for rec in self.roidb:
             bucket = _orientation_bucket(rec, self.cfg.SHAPE_BUCKETS)
             batch = make_batch(
-                [rec], self.cfg, bucket, proposal_count=self.proposal_count
+                [rec], self.cfg, bucket, proposal_count=self.proposal_count,
+                uint8_images=self.cfg.TEST.UINT8_TRANSFER,
+            )
+            batch["orig_hw"] = np.asarray(
+                [[rec["height"], rec["width"]]], np.float32
             )
             yield rec, batch
 
@@ -314,7 +322,11 @@ class TestLoader:
         def build(bucket, chunk):
             recs = [self.roidb[i] for i in chunk]
             batch = make_batch(
-                recs, self.cfg, bucket, proposal_count=self.proposal_count
+                recs, self.cfg, bucket, proposal_count=self.proposal_count,
+                uint8_images=self.cfg.TEST.UINT8_TRANSFER,
+            )
+            batch["orig_hw"] = np.asarray(
+                [[r["height"], r["width"]] for r in recs], np.float32
             )
             return chunk, recs, batch
 

@@ -247,6 +247,9 @@ class FPNFasterRCNN(nn.Module):
         sample_seeds: Optional[jnp.ndarray] = None,
         gt_masks: Optional[jnp.ndarray] = None,
     ):
+        from mx_rcnn_tpu.models.layers import normalize_images
+
+        images = normalize_images(images, im_info, self.cfg)
         if train:
             return self.train_forward(
                 images, im_info, gt_boxes, gt_valid, sample_seeds, gt_masks

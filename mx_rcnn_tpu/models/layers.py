@@ -45,6 +45,32 @@ class FrozenBatchNorm(nn.Module):
         return (x * mul.astype(self.dtype) + add.astype(self.dtype)).astype(self.dtype)
 
 
+def normalize_images(images: jnp.ndarray, im_info, cfg) -> jnp.ndarray:
+    """On-device image normalization for uint8-transferred batches
+    (TEST.UINT8_TRANSFER: raw RGB crosses host→device at 1/4 the bytes).
+    float batches arrive already normalized by the loader and pass
+    through untouched, so every model entry point can call this
+    unconditionally.
+
+    The bucket padding is re-zeroed from ``im_info`` (true pre-padding
+    h/w): the host path pads AFTER normalization, so padding must be 0
+    in normalized space — normalizing raw zero pixels would instead
+    paint the padding "blacker than black" ((0−mean)/std) and shift
+    boundary conv features vs the float path."""
+    if images.dtype != jnp.uint8:
+        return images
+    means = jnp.asarray(cfg.network.PIXEL_MEANS, jnp.float32)
+    inv_stds = 1.0 / jnp.asarray(cfg.network.PIXEL_STDS, jnp.float32)
+    out = (images.astype(jnp.float32) - means) * inv_stds
+    bh, bw = images.shape[1], images.shape[2]
+    rows = jnp.arange(bh, dtype=jnp.float32)[None, :, None, None]
+    cols = jnp.arange(bw, dtype=jnp.float32)[None, None, :, None]
+    mask = (rows < im_info[:, 0, None, None, None]) & (
+        cols < im_info[:, 1, None, None, None]
+    )
+    return out * mask
+
+
 def conv(
     features: int,
     kernel: int,

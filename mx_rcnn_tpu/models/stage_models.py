@@ -107,10 +107,12 @@ class RPNOnly(nn.Module):
         train: bool = False,
         sample_seeds: Optional[jnp.ndarray] = None,
     ):
+        from mx_rcnn_tpu.models.layers import normalize_images
+
         cfg = self.cfg
         t = cfg.TRAIN
         b = images.shape[0]
-        feat = self.backbone(images)
+        feat = self.backbone(normalize_images(images, im_info, cfg))
         rpn_logits, rpn_deltas = self.rpn(feat)
         anchors = self._anchors(feat.shape[1], feat.shape[2])
 
@@ -202,10 +204,12 @@ class FastRCNN(nn.Module):
         sample_seeds: Optional[jnp.ndarray] = None,
     ):
         cfg = self.cfg
+        from mx_rcnn_tpu.models.layers import normalize_images
+
         t = cfg.TRAIN
         b = images.shape[0]
         k = cfg.dataset.NUM_CLASSES
-        feat = self.backbone(images)
+        feat = self.backbone(normalize_images(images, im_info, cfg))
 
         if not train:
             trunk = self._roi_features(feat, proposals)

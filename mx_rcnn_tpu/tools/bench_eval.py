@@ -8,13 +8,21 @@ shape bucket, batched across images, with only the per-class NMS on the
 host (native C, ``native/hostops.c``).
 
 Usage: python -m mx_rcnn_tpu.tools.bench_eval [--batch 8] [--images 64]
+    [--host_path]
 Prints one JSON line {"metric": "eval_imgs_per_sec_per_chip_...", ...}.
 
+Two paths (VERDICT r3 #5):
+- default: uint8 image transfer (4× less relay upload) + device-side
+  per-class decode+NMS in the forward jit (ops/postprocess.py) — only
+  keep lists cross the relay;
+- ``--host_path``: the reference-style loop — f32 upload, full head
+  outputs fetched, per-class native-C NMS on host.
+
 Caveat: on a relay-attached TPU with a weak host (the dev box has one
-CPU core), this measures the HOST — image assembly is ~80 ms/img there
-and the 76 MB/batch upload rides the relay tunnel; the device forward is
-a small fraction.  The TestLoader prefetch thread overlaps assembly with
-the device on real hosts.
+CPU core), the host path measures the HOST — image assembly is
+~80 ms/img there and the 76 MB/batch f32 upload rides the relay tunnel;
+the device forward is a small fraction.  The TestLoader prefetch thread
+overlaps assembly with the device on real hosts.
 """
 
 from __future__ import annotations
@@ -46,13 +54,20 @@ def main():
     ap.add_argument("--images", type=int, default=64)
     ap.add_argument("--network", default="resnet")
     ap.add_argument("--compute_dtype", default="bfloat16")
+    ap.add_argument("--host_path", action="store_true",
+                    help="reference-style f32 upload + host NMS loop")
     args = ap.parse_args()
 
     cfg = generate_config(args.network, "PascalVOC")
     cfg = cfg.replace(
         network=dataclasses.replace(
             cfg.network, COMPUTE_DTYPE=args.compute_dtype
-        )
+        ),
+        TEST=dataclasses.replace(
+            cfg.TEST,
+            DEVICE_POSTPROCESS=not args.host_path,
+            UINT8_TRANSFER=not args.host_path,
+        ),
     )
     h, w = cfg.SHAPE_BUCKETS[0]
     imdb = SyntheticDataset(
@@ -72,13 +87,26 @@ def main():
         np.array([[h, w, 1.0]], np.float32),
         train=False,
     )["params"]
-    predictor = Predictor(model, params)
+    if cfg.TEST.DEVICE_POSTPROCESS:
+        from mx_rcnn_tpu.ops.postprocess import make_test_postprocess
+
+        predictor = Predictor(
+            model, params,
+            postprocess=make_test_postprocess(
+                cfg, imdb.num_classes, 0.05, max_out=cfg.TEST.DET_PER_CLASS
+            ),
+        )
+    else:
+        predictor = Predictor(model, params)
     loader = TestLoader(roidb, cfg, batch_size=args.batch)
 
     def sweep():
         n_det = 0
         for idxs, recs, batch in loader.iter_batched():
             out = predictor.predict(batch)
+            if "det_valid" in out:
+                n_det += int(np.asarray(out["det_valid"]).sum())
+                continue
             for k, (i, rec) in enumerate(zip(idxs, recs)):
                 det = im_detect(
                     out, batch["im_info"][k], (rec["height"], rec["width"]),
@@ -106,6 +134,7 @@ def main():
                 "unit": "imgs/sec/chip",
                 "batch": args.batch,
                 "detections": int(n_det),
+                "path": "host" if args.host_path else "device",
             }
         )
     )

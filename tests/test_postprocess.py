@@ -1,0 +1,140 @@
+"""Device-side eval postprocess (ops/postprocess.py) vs the host
+reference loop, and the uint8-transfer normalize-on-device path.
+
+The host loop (im_detect → per-class threshold → C NMS) is the
+reference semantics; the device path must reproduce its detections
+exactly (same keep sets, same boxes modulo float association).
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.tester import im_detect
+from mx_rcnn_tpu.native.hostops import nms_host
+from mx_rcnn_tpu.ops.postprocess import make_test_postprocess
+
+
+def _fake_outputs(rng, b=2, r=64, k=5):
+    """Plausible raw head outputs: clustered rois + noisy deltas so NMS
+    has real suppression work to do."""
+    rois = np.zeros((b, r, 4), np.float32)
+    centers = rng.rand(b, r, 2) * 300 + 50
+    wh = rng.rand(b, r, 2) * 80 + 20
+    rois[..., 0] = centers[..., 0] - wh[..., 0] / 2
+    rois[..., 1] = centers[..., 1] - wh[..., 1] / 2
+    rois[..., 2] = centers[..., 0] + wh[..., 0] / 2
+    rois[..., 3] = centers[..., 1] + wh[..., 1] / 2
+    valid = rng.rand(b, r) > 0.1
+    logits = rng.randn(b, r, k).astype(np.float32) * 2
+    cls_prob = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    deltas = (rng.randn(b, r, 4 * k) * 0.1).astype(np.float32)
+    im_info = np.tile([400.0, 500.0, 1.6], (b, 1)).astype(np.float32)
+    return {
+        "rois": rois,
+        "roi_valid": valid,
+        "cls_prob": cls_prob.astype(np.float32),
+        "bbox_deltas": deltas,
+    }, im_info
+
+
+class TestDevicePostprocessEquivalence:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_matches_host_reference_loop(self, seed):
+        cfg = generate_config("resnet50", "PascalVOC")
+        te = cfg.TEST
+        thresh = 0.05
+        k = 5
+        rng = np.random.RandomState(seed)
+        out, im_info = _fake_outputs(rng, k=k)
+        orig_hw = np.stack(
+            [np.floor(im_info[:, 0] / im_info[:, 2]),
+             np.floor(im_info[:, 1] / im_info[:, 2])], axis=1
+        ).astype(np.float32)
+        fn = make_test_postprocess(cfg, k, thresh, max_out=32)
+        dev = fn({kk: jnp.asarray(v) for kk, v in out.items()},
+                 jnp.asarray(im_info), jnp.asarray(orig_hw))
+
+        for b in range(out["rois"].shape[0]):
+            det = im_detect(out, im_info[b], tuple(orig_hw[b]), index=b)
+            scores, boxes = det["scores"], det["boxes"]
+            for j in range(1, k):
+                keep = np.where(scores[:, j] > thresh)[0]
+                cls = np.hstack(
+                    [boxes[keep, j * 4:(j + 1) * 4], scores[keep, j:j + 1]]
+                ).astype(np.float32)
+                host = cls[nms_host(cls, te.NMS)]
+                host = host[np.argsort(-host[:, 4])]
+
+                m = np.asarray(dev["det_valid"][b][j - 1]).astype(bool)
+                db = np.asarray(dev["det_boxes"][b][j - 1][m])
+                ds = np.asarray(dev["det_scores"][b][j - 1][m])
+                order = np.argsort(-ds)
+                assert len(ds) == len(host), (
+                    f"img {b} cls {j}: device kept {len(ds)}, host {len(host)}"
+                )
+                np.testing.assert_allclose(ds[order], host[:, 4], rtol=1e-5)
+                np.testing.assert_allclose(
+                    db[order], host[:, :4], rtol=1e-4, atol=1e-3
+                )
+
+
+class TestUint8Transfer:
+    def test_prepare_image_uint8_roundtrip(self):
+        from mx_rcnn_tpu.data.image import prepare_image
+        from mx_rcnn_tpu.models.layers import normalize_images
+
+        cfg = generate_config("resnet50", "PascalVOC")
+        rng = np.random.RandomState(0)
+        im = (rng.rand(200, 300, 3) * 255).astype(np.float32)
+        f32, info_a = prepare_image(
+            im, 128, 256, cfg.network.PIXEL_MEANS, cfg.network.PIXEL_STDS,
+            [(128, 256)],
+        )
+        u8, info_b = prepare_image(
+            im, 128, 256, cfg.network.PIXEL_MEANS, cfg.network.PIXEL_STDS,
+            [(128, 256)], uint8_out=True,
+        )
+        np.testing.assert_array_equal(info_a, info_b)
+        assert u8.dtype == np.uint8
+        info = jnp.asarray(info_a[None])
+        dev = np.asarray(normalize_images(jnp.asarray(u8[None]), info, cfg))[0]
+        # uint8 rounding bounds the divergence at 0.5 LSB / std
+        max_err = 0.5 / min(cfg.network.PIXEL_STDS)
+        assert np.abs(dev - f32).max() <= max_err + 1e-5
+        # bucket padding must be exactly 0 in normalized space, like the
+        # host float path (which pads AFTER normalization)
+        h, w = int(info_a[0]), int(info_a[1])
+        assert (dev[h:] == 0).all() and (dev[:, w:] == 0).all()
+
+    def test_float_batches_pass_through(self):
+        from mx_rcnn_tpu.models.layers import normalize_images
+
+        cfg = generate_config("resnet50", "PascalVOC")
+        x = jnp.ones((1, 4, 4, 3), jnp.float32) * 0.5
+        info = jnp.asarray([[4.0, 4.0, 1.0]])
+        assert normalize_images(x, info, cfg) is x
+
+    def test_testloader_emits_uint8(self):
+        from mx_rcnn_tpu.data.loader import TestLoader
+        from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+
+        cfg = generate_config("resnet50", "PascalVOC")
+        cfg = cfg.replace(
+            SHAPE_BUCKETS=((128, 128),),
+            dataset=dataclasses.replace(
+                cfg.dataset, NUM_CLASSES=4, SCALES=((128, 128),)
+            ),
+        )
+        ds = SyntheticDataset(num_images=1, num_classes=4, image_size=(128, 128))
+        _, batch = next(iter(TestLoader(ds.gt_roidb(), cfg)))
+        assert batch["images"].dtype == np.uint8
+
+        cfg_off = cfg.replace(
+            TEST=dataclasses.replace(cfg.TEST, UINT8_TRANSFER=False)
+        )
+        _, batch = next(iter(TestLoader(ds.gt_roidb(), cfg_off)))
+        assert batch["images"].dtype == np.float32
