@@ -42,6 +42,14 @@ bench:
 bench-eval:
 	$(PY) -m mx_rcnn_tpu.tools.bench_eval
 
-# train→eval mAP gate on synthetic data
+# train→eval mAP gates on synthetic data, one per model family
+# (VERDICT r3 #7): C4 flagship shape, FPN, Mask (polygon gts + segm
+# protocol), VGG, and a data-parallel C4 gate over 8 virtual devices.
+# FPN-family lr: 5e-4 — measured stability limit for random-init
+# frozen-BN after moment calibration (utils/bn_calibrate.py).
 integration-gate:
-	$(PY) -m mx_rcnn_tpu.tools.integration_gate
+	$(PY) -m mx_rcnn_tpu.tools.integration_gate --network resnet50
+	$(PY) -m mx_rcnn_tpu.tools.integration_gate --network resnet_fpn --lr 5e-4
+	$(PY) -m mx_rcnn_tpu.tools.integration_gate --network mask_resnet_fpn --lr 5e-4 --steps 600
+	$(PY) -m mx_rcnn_tpu.tools.integration_gate --network vgg --lr 1e-3
+	$(PY) -m mx_rcnn_tpu.tools.integration_gate --network resnet50 --cpu 8 --dp 8 --steps 200 --target 0.5

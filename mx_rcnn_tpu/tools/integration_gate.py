@@ -95,6 +95,7 @@ def run_gate(
     eval_every: int = 100,
     target: float = 0.8,
     seed: int = 0,
+    dp: int = 0,
 ) -> dict:
     """Train on ``num_images`` synthetic images, eval on the same images.
 
@@ -104,6 +105,12 @@ def run_gate(
     ``target`` is reached.
     """
     cfg = gate_cfg(network)
+    if dp:
+        # data-parallel gate: one image per device over a dp-way mesh,
+        # the exact shard_map train step production uses
+        cfg = cfg.replace(
+            TRAIN=dataclasses.replace(cfg.TRAIN, BATCH_IMAGES=dp)
+        )
     imdb = SyntheticDataset(
         num_images=num_images,
         num_classes=cfg.dataset.NUM_CLASSES,
@@ -129,13 +136,38 @@ def run_gate(
         train=True,
         **batch0,
     )["params"]
+    # random-init frozen-BN networks start unnormalized (the reference
+    # always trains from pretrained weights whose moments match); one
+    # calibration pass writes observed moments into the BNs so the gate
+    # trains stably at reference-scale learning rates (utils/bn_calibrate)
+    import flax.traverse_util as _tu
+
+    if any(p[-1] == "mean" for p in _tu.flatten_dict(params)):
+        from mx_rcnn_tpu.utils.bn_calibrate import calibrate_frozen_bn
+
+        params = calibrate_frozen_bn(model, params, batch0)
     # 10x decay halfway: the constant-lr run overfits noisily (mAP
     # oscillates 0.4-0.7); the decayed tail lets it polish to convergence
     tx = make_optimizer(
         cfg, optax.piecewise_constant_schedule(lr, {steps // 2: 0.1})
     )
-    state = create_train_state(params, tx)
-    step_fn = make_train_step(model, tx, donate=False)
+    if dp:
+        from mx_rcnn_tpu.parallel import (
+            distributed,
+            make_mesh,
+            make_parallel_train_step,
+            replicate,
+        )
+
+        mesh = make_mesh(n_data=dp, n_model=1)
+        state = replicate(create_train_state(jax.device_get(params), tx), mesh)
+        dp_step = make_parallel_train_step(model, tx, mesh)
+
+        def step_fn(st, batch, rng):
+            return dp_step(st, distributed.globalize_batch(dict(batch), mesh), rng)
+    else:
+        state = create_train_state(params, tx)
+        step_fn = make_train_step(model, tx, donate=False)
     rng = jax.random.key(seed + 123)
 
     def eval_gate(state):
@@ -195,6 +227,9 @@ def main():
     p.add_argument("--eval_every", type=int, default=100)
     p.add_argument("--target", type=float, default=0.8)
     p.add_argument("--cpu", type=int, default=0)
+    p.add_argument("--dp", type=int, default=0,
+                   help="data-parallel gate over an N-device mesh "
+                        "(combine with --cpu N for virtual devices)")
     args = p.parse_args()
     if args.cpu:
         from mx_rcnn_tpu.utils.platform import force_cpu
@@ -207,6 +242,7 @@ def main():
         lr=args.lr,
         eval_every=args.eval_every,
         target=args.target,
+        dp=args.dp,
     )
     print(out)
     sys.exit(0 if out["gate"] >= args.target else 1)

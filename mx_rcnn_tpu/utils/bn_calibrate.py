@@ -1,0 +1,97 @@
+"""Data-dependent FrozenBatchNorm calibration for random-init training.
+
+No reference twin — upstream always trains from ImageNet-pretrained
+weights whose BN moments match their conv statistics, so its frozen-BN
+(`use_global_stats=True`) networks start out normalized.  A RANDOM-init
+frozen-BN ResNet has no such luck: moments are (0, 1) while real conv
+outputs drift to O(10²) by the deep stages, so losses start huge and
+SGD diverges at reference learning rates.  The integration gates (and
+any from-scratch run) hit exactly this.
+
+``calibrate_frozen_bn`` runs ONE captured forward pass and writes each
+BN's observed input mean/variance into its frozen ``mean``/``var``
+params — precisely the statistics batch-norm would have used — so the
+network starts normalized and trains stably.  Semantics are unchanged:
+BN stays a frozen affine; only its constants improve.  Pretrained runs
+never need this (their moments are already matched).
+
+Pairing is by the repo's naming convention: ``convX ↔ bnX``,
+``sc ↔ sc_bn``, ``conv0 ↔ bn0`` (see models/resnet.py) — asserted, so
+a renamed module fails loudly rather than silently skipping.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import flax
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bn_to_conv_name(bn: str) -> str:
+    if bn == "sc_bn":
+        return "sc"
+    assert bn.startswith("bn"), f"unrecognized FrozenBatchNorm name {bn!r}"
+    return "conv" + bn[2:]
+
+
+def calibrate_frozen_bn(model, params: Dict, batch: Dict) -> Dict:
+    """→ new params with BN mean/var set to observed input statistics.
+
+    ``batch`` must contain at least ``images``/``im_info`` (a test
+    forward is enough — it executes every backbone/neck BN).
+
+    ONE whole-net sweep, deliberately: stats for every BN are measured
+    under the raw forward, so deep BNs see slightly different inputs
+    once shallow BNs are corrected.  Iterating to self-consistency is
+    tempting but DIVERGES — a channel that is (near-)dead in sweep k
+    gets a large normalization gain, comes alive when sweep k's other
+    updates land, and the gains compound across the residual units into
+    f32 overflow (observed: healthy max|act| 17 after one sweep, inf
+    after two).  The single raw sweep is exact for the first BN and
+    empirically takes the flagship gate from O(1e2) activation std to
+    O(10), which is what SGD stability needs; the variance floor below
+    caps any single BN's gain at 5× as the backstop."""
+    _, state = model.apply(
+        {"params": params},
+        batch["images"],
+        batch["im_info"],
+        train=False,
+        capture_intermediates=True,
+        mutable=["intermediates"],
+    )
+    inter = flax.traverse_util.flatten_dict(state["intermediates"])
+    conv_out = {
+        path[:-1]: vals[0]
+        for path, vals in inter.items()
+        if path[-1] == "__call__"
+    }
+    flat = flax.traverse_util.flatten_dict(params)
+    updated = dict(flat)
+    for path in flat:
+        # a FrozenBatchNorm param group ends (.., <bn_name>, 'mean')
+        if path[-1] != "mean":
+            continue
+        bn_path = path[:-1]
+        if (bn_path + ("var",)) not in flat:
+            continue
+        conv_path = bn_path[:-1] + (_bn_to_conv_name(bn_path[-1]),)
+        assert conv_path in conv_out, (
+            f"no captured conv output {conv_path} for BN {bn_path}"
+        )
+        x = jnp.asarray(conv_out[conv_path], jnp.float32)
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        # variance floor RELATIVE to the channel mean: a (near-)dead
+        # channel with var→0 would get a ~1/√eps ≈ 10³ normalization
+        # gain that amplifies wildly once training (or the corrected
+        # upstream) shifts its input distribution.  Flooring at
+        # (20% of |mean|)² + 0.04 caps the affine gain at 5× for any
+        # input scale.
+        var = jnp.maximum(var, 0.04 * (mean * mean + 1.0))
+        updated[bn_path + ("mean",)] = np.asarray(mean, np.float32)
+        updated[bn_path + ("var",)] = np.asarray(var, np.float32)
+    return flax.traverse_util.unflatten_dict(updated)

@@ -1,0 +1,66 @@
+"""Round-4 scratch probe: FrozenBN calibration effect on gate stability."""
+import sys
+import time
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.core.train import create_train_state, make_optimizer, make_train_step
+from mx_rcnn_tpu.data.loader import TrainLoader
+from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.tools.integration_gate import gate_cfg
+from mx_rcnn_tpu.utils.bn_calibrate import calibrate_frozen_bn
+
+network = sys.argv[1] if len(sys.argv) > 1 else "mask_resnet_fpn"
+lr = float(sys.argv[2]) if len(sys.argv) > 2 else 2e-3
+steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+
+cfg = gate_cfg(network)
+imdb = SyntheticDataset(
+    num_images=4, num_classes=4, image_size=(128, 128), max_boxes=2,
+    seed=0, with_masks=cfg.network.USE_MASK,
+)
+roidb = imdb.gt_roidb()
+model = build_model(cfg)
+loader = TrainLoader(roidb, cfg, 2, shuffle=True, seed=0)
+b0 = next(iter(loader))
+t0 = time.time()
+params = model.init(
+    {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+    train=True, **b0,
+)["params"]
+print("init", round(time.time() - t0, 1), flush=True)
+
+
+def probe_loss(p, tag):
+    loss, aux = model.apply(
+        {"params": p}, train=True, rngs={"sampling": jax.random.key(2)}, **b0
+    )
+    print(tag, "loss", round(float(loss), 2),
+          "RPNLog", round(float(aux["RPNLogLoss"]), 2),
+          "RCNNLog", round(float(aux["RCNNLogLoss"]), 2), flush=True)
+
+
+probe_loss(params, "pre-cal ")
+t0 = time.time()
+params = calibrate_frozen_bn(model, params, b0)
+print("calibrate", round(time.time() - t0, 1), flush=True)
+probe_loss(params, "post-cal")
+
+tx = make_optimizer(cfg, lambda s: lr)
+state = create_train_state(params, tx)
+step = make_train_step(model, tx, donate=False)
+losses = []
+it = iter(loader)
+i = 0
+while i < steps:
+    try:
+        batch = next(it)
+    except StopIteration:
+        it = iter(loader)
+        continue
+    state, aux = step(state, batch, jax.random.key(123))
+    losses.append(round(float(aux["loss"]), 2))
+    i += 1
+print("losses", losses, flush=True)
