@@ -151,3 +151,49 @@ class TestCOCOParsing:
         stats = imdb.evaluate_detections(all_boxes)
         assert stats["AP"] == pytest.approx(1.0)
         assert stats["AP50"] == pytest.approx(1.0)
+
+
+class TestCheckDataProbe:
+    def test_voc_probe_reports_missing_then_ready(self, voc_devkit):
+        from mx_rcnn_tpu.tools.check_data import probe_voc
+
+        ok, lines = probe_voc(voc_devkit)
+        assert not ok
+        missing = "\n".join(ln for ln in lines if "MISSING" in ln)
+        assert "000001.jpg" in missing and "test.txt" in missing
+
+        base = os.path.join(voc_devkit, "VOC2007")
+        for idx in ("000001", "000002"):
+            with open(os.path.join(base, "JPEGImages", f"{idx}.jpg"), "wb") as f:
+                f.write(b"\xff\xd8\xff\xd9")
+        with open(
+            os.path.join(base, "ImageSets", "Main", "test.txt"), "w"
+        ) as f:
+            f.write("000002\n")
+        ok, lines = probe_voc(voc_devkit)
+        assert ok, lines
+
+    def test_coco_probe(self, tmp_path):
+        from mx_rcnn_tpu.tools.check_data import probe_coco
+
+        root = tmp_path / "coco"
+        ok, _ = probe_coco(str(root))
+        assert not ok
+        (root / "annotations").mkdir(parents=True)
+        (root / "val2017").mkdir()
+        (root / "train2017").mkdir()
+        ds = {
+            "images": [{"id": 1, "file_name": "a.jpg", "height": 4, "width": 4}],
+            "annotations": [],
+            "categories": [{"id": 1, "name": "x"}],
+        }
+        for split in ("train2017", "val2017"):
+            with open(root / "annotations" / f"instances_{split}.json", "w") as f:
+                json.dump(ds, f)
+        (root / "val2017" / "a.jpg").write_bytes(b"\xff\xd8\xff\xd9")
+        # empty train image dir must fail the probe
+        ok, lines = probe_coco(str(root))
+        assert not ok and any("no files" in ln for ln in lines)
+        (root / "train2017" / "b.jpg").write_bytes(b"\xff\xd8\xff\xd9")
+        ok, lines = probe_coco(str(root))
+        assert ok, lines
