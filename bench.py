@@ -1,13 +1,18 @@
-"""Benchmark: ResNet-101 Faster R-CNN end-to-end train throughput.
+"""Benchmark: end-to-end train throughput per model family.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "imgs/sec/chip", "vs_baseline": N/30}
+
+Default (driver) config: ResNet-101 C4 Faster R-CNN, the flagship.
+``--network resnet_fpn`` / ``--network mask_resnet_fpn`` benchmark the
+BASELINE config-4/5 graphs (VERDICT r3 #3) with the same JSON contract.
 
 Baseline = the 30 imgs/sec/chip north-star target from BASELINE.json
 (the reference never published per-chip throughput; its GPU-era numbers
 were O(2-5) imgs/sec/GPU).
 """
 
+import argparse
 import dataclasses
 import json
 import time
@@ -18,40 +23,55 @@ BASELINE_IMGS_PER_SEC_PER_CHIP = 30.0
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--network", default="resnet",
+        choices=["resnet", "resnet50", "resnet_fpn", "mask_resnet_fpn"],
+    )
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
     import jax
 
     from mx_rcnn_tpu.utils.platform import enable_compile_cache
 
     enable_compile_cache()
 
-    from __graft_entry__ import _batch, _flagship_cfg
+    from __graft_entry__ import _batch
+    from mx_rcnn_tpu.config import generate_config
     from mx_rcnn_tpu.core.train import (
         create_train_state,
         make_optimizer,
         make_train_step,
     )
-    from mx_rcnn_tpu.models import FasterRCNN
+    from mx_rcnn_tpu.models import build_model
 
-    cfg = _flagship_cfg()
+    cfg = generate_config(args.network, "PascalVOC")
     # The perf configuration: bf16 compute (f32 params) rides the MXU, and
     # 8 images/chip/step amortize fixed per-step costs (measured: b1=29.9,
-    # b2=40.2, b4=44.6, b8=52.9 img/s).  entry()/dryrun keep f32 batch-1
-    # for conservative compile/correctness checks.
+    # b2=40.2, b4=44.6, b8=52.9 img/s on the C4 flagship).  entry()/dryrun
+    # keep f32 batch-1 for conservative compile/correctness checks.
     cfg = cfg.replace(
         network=dataclasses.replace(cfg.network, COMPUTE_DTYPE="bfloat16"),
-        TRAIN=dataclasses.replace(cfg.TRAIN, BATCH_IMAGES=8),
+        TRAIN=dataclasses.replace(cfg.TRAIN, BATCH_IMAGES=args.batch),
     )
-    model = FasterRCNN(cfg)
+    model = build_model(cfg)
     h, w = cfg.SHAPE_BUCKETS[0]
     b = cfg.TRAIN.BATCH_IMAGES
     batch = _batch(cfg, b, h, w)
+    if cfg.network.USE_MASK:
+        # all-ones box-frame bitmaps: same shapes/flops as real polygon
+        # gts through crop_resize_masks (the bitmap content is data)
+        batch["gt_masks"] = np.ones(
+            (b, batch["gt_boxes"].shape[1], cfg.TRAIN.MASK_GT_SIZE,
+             cfg.TRAIN.MASK_GT_SIZE),
+            np.uint8,
+        )
     params = model.init(
         {"params": jax.random.key(0), "sampling": jax.random.key(1)},
-        batch["images"],
-        batch["im_info"],
-        batch["gt_boxes"],
-        batch["gt_valid"],
         train=True,
+        **batch,
     )["params"]
     tx = make_optimizer(cfg, lambda s: cfg.TRAIN.LEARNING_RATE)
     state = create_train_state(params, tx)
@@ -63,7 +83,7 @@ def main():
     state, aux = step(state, batch, rng)
     float(aux["loss"])
 
-    iters = 20
+    iters = args.iters
     t0 = time.perf_counter()
     for _ in range(iters):
         state, aux = step(state, batch, rng)
@@ -72,11 +92,17 @@ def main():
     assert np.isfinite(float(aux["loss"]))
     dt = time.perf_counter() - t0
 
+    name = {
+        "resnet": "resnet101_e2e",
+        "resnet50": "resnet50_e2e",
+        "resnet_fpn": "resnet50_fpn_e2e",
+        "mask_resnet_fpn": "mask_resnet101_fpn_e2e",
+    }[args.network]
     imgs_per_sec = b * iters / dt
     print(
         json.dumps(
             {
-                "metric": "train_imgs_per_sec_per_chip_resnet101_e2e",
+                "metric": f"train_imgs_per_sec_per_chip_{name}",
                 "value": round(imgs_per_sec, 3),
                 "unit": "imgs/sec/chip",
                 "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),

@@ -174,20 +174,23 @@ def extract_roi_features_batched(
     """
     from mx_rcnn_tpu.utils.platform import use_pallas
 
-    # The Pallas kernel keeps one (H, W, cblk) feature block VMEM-resident;
-    # huge maps (FPN P2 at 600×1000 is 150×250) exceed the budget even at
-    # the smallest channel block — fall back to the chunked-gather path
-    # there (future work: row-blocked DMA driven by roi extents)
+    # Two Pallas kernels: the resident one keeps an (H, W, cblk) feature
+    # block in VMEM across the roi sweep; maps over the budget (FPN P2 at
+    # flagship resolution is 152×256) take the STREAMING kernel, which
+    # row-blocks the feature through VMEM and accumulates the roi-block
+    # outputs in scratch (ops/pallas/roi_align_stream.py)
     from mx_rcnn_tpu.ops.pallas.roi_align import fits_vmem
 
-    if (
-        mode == "roi_align"
-        and use_pallas()
-        and fits_vmem(feat.shape[1], feat.shape[2], feat.shape[3])
-    ):
-        from mx_rcnn_tpu.ops.pallas.roi_align import roi_align_pallas
+    if mode == "roi_align" and use_pallas():
+        if fits_vmem(feat.shape[1], feat.shape[2], feat.shape[3]):
+            from mx_rcnn_tpu.ops.pallas.roi_align import roi_align_pallas
 
-        return roi_align_pallas(feat, rois, pooled, spatial_scale, sample_ratio)
+            return roi_align_pallas(
+                feat, rois, pooled, spatial_scale, sample_ratio
+            )
+        from mx_rcnn_tpu.ops.pallas.roi_align_stream import roi_align_stream
+
+        return roi_align_stream(feat, rois, pooled, spatial_scale, sample_ratio)
     return jax.vmap(
         lambda f, r: extract_roi_features(
             f, r, mode, pooled, spatial_scale, sample_ratio

@@ -82,3 +82,135 @@ class TestPallasRoiAlign:
         np.testing.assert_allclose(
             np.asarray(got, np.float32), np.asarray(ref), rtol=0.05, atol=0.05
         )
+
+
+class TestStreamingRoiAlign:
+    """Streaming (row-blocked) kernel for over-VMEM maps: must match the
+    gather reference exactly (interpret mode), including rois that
+    straddle row-block boundaries and R not divisible by the roi block."""
+
+    @pytest.fixture
+    def rng(self):
+        return np.random.RandomState(7)
+
+    def test_fwd_matches_jnp(self, rng):
+        from mx_rcnn_tpu.ops.pallas.roi_align_stream import roi_align_stream
+
+        h, w, c = 40, 64, 128  # hblk=64? _pick_hblk(64,128)=64 -> force blocks
+        feat = jnp.asarray(rng.randn(h, w, c).astype(np.float32))
+        rois = jnp.asarray(random_rois(rng, 11, h * 4, w * 4))
+        ref = roi_align(feat, rois, (7, 7), 0.25, 2)
+        got = roi_align_stream(feat[None], rois[None], (7, 7), 0.25, 2, True)[0]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+    def test_fwd_small_row_blocks(self, rng, monkeypatch):
+        """Force tiny row blocks so every roi straddles many blocks."""
+        from mx_rcnn_tpu.ops.pallas import roi_align_stream as mod
+
+        monkeypatch.setattr(mod, "_pick_hblk", lambda w, cblk, budget=0: 8)
+        h, w, c = 33, 16, 128  # 33 rows -> 5 blocks incl. ragged last
+        feat = jnp.asarray(rng.randn(h, w, c).astype(np.float32))
+        rois = jnp.asarray(random_rois(rng, 6, h * 4, w * 4))
+        ref = roi_align(feat, rois, (7, 7), 0.25, 2)
+        got = mod.roi_align_stream(feat[None], rois[None], (7, 7), 0.25, 2, True)[0]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+    def test_bwd_matches_jnp_grad(self, rng, monkeypatch):
+        from mx_rcnn_tpu.ops.pallas import roi_align_stream as mod
+
+        monkeypatch.setattr(mod, "_pick_hblk", lambda w, cblk, budget=0: 8)
+        h, w, c = 26, 20, 128
+        feat = jnp.asarray(rng.randn(h, w, c).astype(np.float32))
+        rois = jnp.asarray(random_rois(rng, 5, h * 4, w * 4))
+        cot = jnp.asarray(rng.randn(5, 7, 7, c).astype(np.float32))
+        ref_grad = jax.grad(
+            lambda f: (roi_align(f, rois, (7, 7), 0.25, 2) * cot).sum()
+        )(feat)
+        got_grad = jax.grad(
+            lambda f: (
+                mod.roi_align_stream(f[None], rois[None], (7, 7), 0.25, 2, True)[0]
+                * cot
+            ).sum()
+        )(feat)
+        np.testing.assert_allclose(
+            np.asarray(got_grad), np.asarray(ref_grad), rtol=1e-4, atol=1e-4
+        )
+
+    def test_batched_and_bf16(self, rng):
+        from mx_rcnn_tpu.ops.pallas.roi_align_stream import roi_align_stream
+
+        b, h, w, c = 2, 24, 32, 128
+        feat = jnp.asarray(rng.randn(b, h, w, c).astype(np.float32))
+        rois = jnp.stack(
+            [jnp.asarray(random_rois(rng, 4, h * 4, w * 4)) for _ in range(b)]
+        )
+        ref = jax.vmap(lambda f, r: roi_align(f, r, (7, 7), 0.25, 2))(feat, rois)
+        got = roi_align_stream(
+            feat.astype(jnp.bfloat16), rois, (7, 7), 0.25, 2, True
+        )
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref), rtol=0.05, atol=0.05
+        )
+
+    def test_degenerate_and_offscreen_rois(self, rng, monkeypatch):
+        """Sub-cell-height rois reach ~y1+1 in sample space (the
+        min-length clamp), so their hi-neighbour row can live in the
+        NEXT row block; rois clipped off the map edges still touch the
+        edge rows.  Block-skip must not drop those contributions."""
+        from mx_rcnn_tpu.ops.pallas import roi_align_stream as mod
+
+        monkeypatch.setattr(mod, "_pick_hblk", lambda w, cblk, budget=0: 8)
+        h, w, c = 24, 16, 128
+        feat = jnp.asarray(rng.randn(h, w, c).astype(np.float32))
+        rois = jnp.asarray(
+            [
+                # floor(y1*scale)=6 == block_boundary-2 (hblk 8), height<1 cell
+                [8.0, 27.6, 20.0, 27.6],
+                # y extent fully above the map (clips to row 0)
+                [4.0, -300.0, 40.0, -200.0],
+                # y extent fully below the map (clips to last row)
+                [4.0, 500.0, 40.0, 600.0],
+                # straddles the last ragged block edge
+                [2.0, 91.0, 30.0, 95.9],
+            ],
+            jnp.float32,
+        )
+        ref = roi_align(feat, rois, (7, 7), 0.25, 2)
+        got = mod.roi_align_stream(feat[None], rois[None], (7, 7), 0.25, 2, True)[0]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+        # gradients through the same rois
+        cot = jnp.asarray(rng.randn(4, 7, 7, c).astype(np.float32))
+        ref_g = jax.grad(
+            lambda f: (roi_align(f, rois, (7, 7), 0.25, 2) * cot).sum()
+        )(feat)
+        got_g = jax.grad(
+            lambda f: (
+                mod.roi_align_stream(f[None], rois[None], (7, 7), 0.25, 2, True)[0]
+                * cot
+            ).sum()
+        )(feat)
+        np.testing.assert_allclose(
+            np.asarray(got_g), np.asarray(ref_g), rtol=1e-4, atol=1e-4
+        )
+
+    def test_mask_head_pooled_14(self, rng):
+        """pooled=(14,14) (the mask head) auto-shrinks the roi block so
+        the scratch accumulator stays within VMEM budget."""
+        from mx_rcnn_tpu.ops.pallas import roi_align_stream as mod
+
+        assert mod._pick_rblk((14, 14), 128) <= 48
+        h, w, c = 20, 24, 128
+        feat = jnp.asarray(rng.randn(h, w, c).astype(np.float32))
+        rois = jnp.asarray(random_rois(rng, 5, h * 4, w * 4))
+        ref = roi_align(feat, rois, (14, 14), 0.25, 2)
+        got = mod.roi_align_stream(feat[None], rois[None], (14, 14), 0.25, 2, True)[0]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
