@@ -68,6 +68,7 @@ def _row_matrices(rois_ref, b, r, hf: int, wf: int, offset, hblk: int,
     y1 = rois_ref[b, 1, r] * scale
     x2 = rois_ref[b, 2, r] * scale
     y2 = rois_ref[b, 3, r] * scale
+    valid = x2 >= x1  # inverted boxes are _pad_rois fillers
     ylo, ywhi = _sample_coords(y1, y2, hf, ph, s)
     xlo, xwhi = _sample_coords(x1, x2, wf, pw, s)
     # cap: when lo is the last row/col, send the hi-weight to lo as well
@@ -93,7 +94,7 @@ def _row_matrices(rois_ref, b, r, hf: int, wf: int, offset, hblk: int,
     hi_cell = jnp.clip(
         jnp.floor(y1 + jnp.maximum(y2 - y1, 1.0)) + 1.0, 0.0, float(hf - 1)
     )
-    return my, mx, lo_cell, hi_cell
+    return my, mx, valid, lo_cell, hi_cell
 
 
 def _fwd_kernel(rois_ref, feat_ref, out_ref, acc_ref, *, pooled, s, scale,
@@ -118,12 +119,12 @@ def _fwd_kernel(rois_ref, feat_ref, out_ref, acc_ref, *, pooled, s, scale,
 
     def body(i, _):
         r = rb * rblk + i
-        my, mx, lo_cell, hi_cell = _row_matrices(
+        my, mx, valid, lo_cell, hi_cell = _row_matrices(
             rois_ref, b, r, hf, wf, offset, hblk, pooled, s, scale
         )
 
-        # skip row blocks outside the roi's sample-support extent
-        @pl.when((hi_cell >= offset) & (lo_cell <= offset + (hblk - 1)))
+        # skip fillers and row blocks outside the sample-support extent
+        @pl.when(valid & (hi_cell >= offset) & (lo_cell <= offset + (hblk - 1)))
         def _():
             if f32:
                 rows = jax.lax.dot_general(
@@ -145,7 +146,11 @@ def _fwd_kernel(rois_ref, feat_ref, out_ref, acc_ref, *, pooled, s, scale,
                     mx.astype(jnp.bfloat16), rows, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )
-            acc_ref[i] = acc_ref[i] + out.transpose(1, 0, 2)
+            # TRANSPOSED accumulator (PW, PH, CB) — the second dot's
+            # natural order; one transpose at the flush replaces
+            # R×n_hblk in-kernel transposes (the resident backward
+            # measured that pattern at 35 ms)
+            acc_ref[i] = acc_ref[i] + out
 
         return 0
 
@@ -153,11 +158,11 @@ def _fwd_kernel(rois_ref, feat_ref, out_ref, acc_ref, *, pooled, s, scale,
 
     @pl.when(hb == n_hblk - 1)
     def _():
-        out_ref[0] = acc_ref[...].astype(out_ref.dtype)
+        out_ref[0] = acc_ref[...].transpose(0, 2, 1, 3).astype(out_ref.dtype)
 
 
 def _bwd_kernel(rois_ref, g_ref, dfeat_ref, *, pooled, s, scale, hblk,
-                rblk, n_rblk, hf):
+                rblk, hf):
     b = pl.program_id(0)
     hb = pl.program_id(2)
     rb = pl.program_id(3)
@@ -179,11 +184,11 @@ def _bwd_kernel(rois_ref, g_ref, dfeat_ref, *, pooled, s, scale, hblk,
 
     def body(i, _):
         r = rb * rblk + i
-        my, mx, lo_cell, hi_cell = _row_matrices(
+        my, mx, valid, lo_cell, hi_cell = _row_matrices(
             rois_ref, b, r, hf, wf, offset, hblk, pooled, s, scale
         )
 
-        @pl.when((hi_cell >= offset) & (lo_cell <= offset + (hblk - 1)))
+        @pl.when(valid & (hi_cell >= offset) & (lo_cell <= offset + (hblk - 1)))
         def _():
             g = g_ref[0, i].astype(jnp.float32)                  # (PH, PW, CB)
             # t: (W, PH, CB) = Mxᵀ contract PW;  d: (hblk, W, CB)
@@ -219,8 +224,12 @@ def _pad_rois(rois, rblk):
     b, r, _ = rois.shape
     pad = (-r) % rblk
     if pad:
-        # far-offscreen padding rois: intersect no row block, add nothing
-        filler = jnp.full((b, pad, 4), -1e6, rois.dtype)
+        # inverted (x2 < x1) filler rois: the kernels' validity term in
+        # the block-skip predicate drops them entirely, so padding costs
+        # no MXU work (their rows would otherwise clip into block 0)
+        filler = jnp.tile(
+            jnp.asarray([0.0, 0.0, -1.0, -1.0], rois.dtype), (b, pad, 1)
+        )
         rois = jnp.concatenate([rois, filler], axis=1)
     return rois, r
 
@@ -254,7 +263,8 @@ def _fwd_impl(feat, rois, pooled, scale, s, interpret, rblk=None):
                 lambda bb, cb, rb, hb, rois_ref: (bb, rb, 0, 0, cb),
             ),
             scratch_shapes=[
-                pltpu.VMEM((rblk, pooled[0], pooled[1], cblk), jnp.float32)
+                # transposed (PW, PH) layout — see the kernel's flush
+                pltpu.VMEM((rblk, pooled[1], pooled[0], cblk), jnp.float32)
             ],
         ),
         out_shape=jax.ShapeDtypeStruct(
@@ -282,7 +292,7 @@ def _bwd_impl(feat_shape, feat_dtype, rois, g, pooled, scale, s, interpret,
     grid = (b, c // cblk, n_hblk, n_rblk)
     kernel = partial(
         _bwd_kernel, pooled=pooled, s=s, scale=scale, hblk=hblk,
-        rblk=rblk, n_rblk=n_rblk, hf=hf,
+        rblk=rblk, hf=hf,
     )
     out = pl.pallas_call(
         kernel,

@@ -60,6 +60,12 @@ def gate_cfg(network: str = "resnet50", num_classes: int = 4):
         net_over["ANCHOR_SCALES"] = (2, 4, 8)
     if cfg.network.depth > 50 and cfg.network.name == "resnet":
         net_over["depth"] = 50  # mask registry defaults to 101; gate speed
+    # FPN's stride-4 anchors make proposals saturate the fg/bg IoU
+    # boundary once the RPN tightens (measured: RCNN head collapses to
+    # the 75% bg prior at the C4 gate's 64-proposal budget); a wider
+    # proposal pool and roi batch restore bg diversity for the sampler
+    post_nms = 192 if cfg.network.USE_FPN else 64
+    batch_rois = 64 if cfg.network.USE_FPN else 32
     return cfg.replace(
         SHAPE_BUCKETS=((128, 128),),
         network=dataclasses.replace(cfg.network, **net_over),
@@ -70,8 +76,8 @@ def gate_cfg(network: str = "resnet50", num_classes: int = 4):
         TRAIN=dataclasses.replace(
             cfg.TRAIN,
             RPN_PRE_NMS_TOP_N=400,
-            RPN_POST_NMS_TOP_N=64,
-            BATCH_ROIS=32,
+            RPN_POST_NMS_TOP_N=post_nms,
+            BATCH_ROIS=batch_rois,
             RPN_BATCH_SIZE=64,
             BATCH_IMAGES=2,
             # small data + short schedule: no flip (run_gate applies a
@@ -81,7 +87,7 @@ def gate_cfg(network: str = "resnet50", num_classes: int = 4):
         TEST=dataclasses.replace(
             cfg.TEST,
             RPN_PRE_NMS_TOP_N=200,
-            RPN_POST_NMS_TOP_N=32,
+            RPN_POST_NMS_TOP_N=64 if cfg.network.USE_FPN else 32,
             SCORE_THRESH=0.05,
         ),
     )

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-4 TPU work queue: runs once the axon relay is back.
+# Usage: PYTHONPATH=/root/.axon_site:/root/repo bash scripts/tpu_queue.sh
+set -u
+cd /root/repo
+log() { echo "[tpu_queue $(date +%H:%M:%S)] $*"; }
+
+# wait for the relay (up to ~2h), probing with a tiny device query
+up=0
+for i in $(seq 1 240); do
+    if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        log "relay is up"
+        up=1
+        break
+    fi
+    sleep 30
+done
+if [ "$up" != 1 ]; then
+    log "relay never came up — aborting queue"
+    exit 1
+fi
+
+run() {
+    name=$1; shift
+    log "START $name"
+    timeout 4000 "$@" > "/tmp/q_$name.log" 2>&1
+    log "DONE $name exit=$? (log /tmp/q_$name.log)"
+}
+
+run stream_kernel python -u scripts/probe_stream_kernel.py
+run bench_c4 python bench.py
+run bench_fpn python bench.py --network resnet_fpn
+run bench_mask python bench.py --network mask_resnet_fpn
+run backbone python -u scripts/probe_backbone.py all
+run fpn_gate python -m mx_rcnn_tpu.tools.integration_gate \
+    --network resnet_fpn --lr 5e-4 --steps 1200 --eval_every 200
+log "queue complete"
