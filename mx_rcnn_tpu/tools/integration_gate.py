@@ -143,12 +143,15 @@ def run_gate(
         **batch0,
     )["params"]
     # random-init frozen-BN networks start unnormalized (the reference
-    # always trains from pretrained weights whose moments match); one
-    # calibration pass writes observed moments into the BNs so the gate
-    # trains stably at reference-scale learning rates (utils/bn_calibrate)
-    import flax.traverse_util as _tu
-
-    if any(p[-1] == "mean" for p in _tu.flatten_dict(params)):
+    # always trains from pretrained weights whose moments match).  For
+    # the FPN family this diverges at any workable lr (measured: loss
+    # 83 → e15), so one calibration pass writes observed moments into
+    # the BNs (utils/bn_calibrate).  The C4 family is deliberately LEFT
+    # UNCALIBRATED: its oversized activations ride the gradient clip to
+    # fast overfit (0.92 mAP @ 300 steps), and normalizing them shrinks
+    # gradients enough that the same budget reaches only ~0.003
+    # (measured regression when calibration was applied unconditionally).
+    if cfg.network.USE_FPN:
         from mx_rcnn_tpu.utils.bn_calibrate import calibrate_frozen_bn
 
         params = calibrate_frozen_bn(model, params, batch0)

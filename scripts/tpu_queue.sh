@@ -3,12 +3,16 @@
 # Usage: PYTHONPATH=/root/.axon_site:/root/repo bash scripts/tpu_queue.sh
 set -u
 cd /root/repo
+export JAX_PLATFORMS=axon  # a silent CPU fallback must FAIL the probe
 log() { echo "[tpu_queue $(date +%H:%M:%S)] $*"; }
 
-# wait for the relay (up to ~2h), probing with a tiny device query
+# wait for the relay (up to ~2h), probing with a tiny device query that
+# asserts the device really is the TPU, not a fallback backend
 up=0
 for i in $(seq 1 240); do
-    if timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    if timeout 45 python -c \
+        "import jax; d = jax.devices(); assert d[0].platform != 'cpu', d" \
+        >/dev/null 2>&1; then
         log "relay is up"
         up=1
         break
@@ -20,11 +24,14 @@ if [ "$up" != 1 ]; then
     exit 1
 fi
 
+fails=0
 run() {
     name=$1; shift
     log "START $name"
     timeout 4000 "$@" > "/tmp/q_$name.log" 2>&1
-    log "DONE $name exit=$? (log /tmp/q_$name.log)"
+    rc=$?
+    [ $rc -ne 0 ] && fails=$((fails + 1))
+    log "DONE $name exit=$rc (log /tmp/q_$name.log)"
 }
 
 run stream_kernel python -u scripts/probe_stream_kernel.py
@@ -34,4 +41,5 @@ run bench_mask python bench.py --network mask_resnet_fpn
 run backbone python -u scripts/probe_backbone.py all
 run fpn_gate python -m mx_rcnn_tpu.tools.integration_gate \
     --network resnet_fpn --lr 5e-4 --steps 1200 --eval_every 200
-log "queue complete"
+log "queue complete ($fails failed)"
+exit $((fails > 0))
