@@ -40,6 +40,6 @@ run bench_fpn python bench.py --network resnet_fpn
 run bench_mask python bench.py --network mask_resnet_fpn
 run backbone python -u scripts/probe_backbone.py all
 run fpn_gate python -m mx_rcnn_tpu.tools.integration_gate \
-    --network resnet_fpn --lr 5e-4 --steps 1200 --eval_every 200
+    --network resnet_fpn --lr 5e-4 --steps 1200 --eval_every 200 --target 0.5
 log "queue complete ($fails failed)"
 exit $((fails > 0))
