@@ -146,6 +146,11 @@ class NetworkConfig:
     USE_MASK: bool = False
     # compute dtype for conv/matmul ("bfloat16" rides the MXU; params stay f32)
     COMPUTE_DTYPE: str = "float32"
+    # fold frozen-BN affines into conv kernels at apply time (exact
+    # algebraic rewrite, identical param tree — models/layers.fused_conv_bn;
+    # the fold multiplies the f32 weight instead of the activation, so the
+    # activation-side scale/shift and its backward twin disappear)
+    FOLD_BN: bool = True
 
 
 @dataclass(frozen=True)

@@ -135,6 +135,7 @@ class FPNFasterRCNN(nn.Module):
             frozen_prefix=frozen_prefix_len(
                 cfg.network.FIXED_PARAMS, RESNET_BLOCK_ORDER, requires=("bn",)
             ),
+            fold_bn=cfg.network.FOLD_BN,
         )
         self.neck = FPNNeck(channels=cfg.network.FPN_CHANNELS, dtype=dtype)
         # one RPN head shared across levels (FPN paper); 3 anchors/cell
@@ -220,7 +221,9 @@ class FPNFasterRCNN(nn.Module):
         )
         return out_boxes, out_scores, out_valid
 
-    def _roi_features(self, pyramid, rois: jnp.ndarray) -> jnp.ndarray:
+    def _roi_features(
+        self, pyramid, rois: jnp.ndarray, fwd_only: bool = False
+    ) -> jnp.ndarray:
         """Masked multi-level ROIAlign: (B, R, 4) → (B*R, D)."""
         net = self.cfg.network
         levels = roi_levels(rois)                        # (B, R) in [2, 5]
@@ -228,7 +231,7 @@ class FPNFasterRCNN(nn.Module):
         for li, stride in enumerate(net.FPN_FEAT_STRIDES[:4]):  # P2..P5
             feats = extract_roi_features_batched(
                 pyramid[li], rois, "roi_align", net.POOLED_SIZE,
-                1.0 / stride, net.ROI_SAMPLE_RATIO,
+                1.0 / stride, net.ROI_SAMPLE_RATIO, fwd_only=fwd_only,
             )                                            # (B, R, ph, pw, C)
             mask = (levels == li + 2)[..., None, None, None]
             contrib = jnp.where(mask, feats, 0.0)
@@ -357,7 +360,7 @@ class FPNFasterRCNN(nn.Module):
             )
         )(fg_scores, rpn_deltas, im_info)
 
-        trunk = self._roi_features(pyramid, rois)
+        trunk = self._roi_features(pyramid, rois, fwd_only=True)
         cls_logits, bbox_deltas = self.rcnn(trunk)
         r = te.RPN_POST_NMS_TOP_N
         means, stds = bbox_denorm_vectors(cfg, k)
@@ -374,7 +377,7 @@ class FPNFasterRCNN(nn.Module):
         return out
 
     # ------------------------------------------------------------- mask head
-    def _mask_pooled(self, pyramid, rois):
+    def _mask_pooled(self, pyramid, rois, fwd_only: bool = False):
         """(B, R, 4) → (B*R, 14, 14, C) mask-branch roi features."""
         net = self.cfg.network
         levels = roi_levels(rois)
@@ -382,7 +385,7 @@ class FPNFasterRCNN(nn.Module):
         for li, stride in enumerate(net.FPN_FEAT_STRIDES[:4]):
             feats = extract_roi_features_batched(
                 pyramid[li], rois, "roi_align", (14, 14),
-                1.0 / stride, net.ROI_SAMPLE_RATIO,
+                1.0 / stride, net.ROI_SAMPLE_RATIO, fwd_only=fwd_only,
             )
             mask = (levels == li + 2)[..., None, None, None]
             contrib = jnp.where(mask, feats, 0.0)
@@ -391,9 +394,9 @@ class FPNFasterRCNN(nn.Module):
         return pooled.reshape((b * r,) + pooled.shape[2:])
 
     def _mask_forward(self, pyramid, rois):
-        """→ (B, R, 28, 28, K) per-class mask logits."""
+        """→ (B, R, 28, 28, K) per-class mask logits (test path)."""
         b, r = rois.shape[0], rois.shape[1]
-        logits = self.mask_head(self._mask_pooled(pyramid, rois))
+        logits = self.mask_head(self._mask_pooled(pyramid, rois, fwd_only=True))
         return logits.reshape((b, r) + logits.shape[1:])
 
     def _mask_loss(self, pyramid, samples, gt_boxes, gt_valid, gt_masks=None):

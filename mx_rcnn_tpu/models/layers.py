@@ -16,6 +16,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+# the reference's BN epsilon (use_global_stats=True, eps 2e-5); shared by
+# the unfused FrozenBatchNorm and the folded fused_conv_bn so the two
+# graphs can never silently diverge
+BN_EPS = 2e-5
+
 
 class FrozenBatchNorm(nn.Module):
     """BatchNorm with frozen moments: y = (x - mean) / sqrt(var + eps) * γ + β.
@@ -27,7 +32,7 @@ class FrozenBatchNorm(nn.Module):
     gammas/betas).
     """
 
-    eps: float = 2e-5
+    eps: float = BN_EPS
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -69,6 +74,96 @@ def normalize_images(images: jnp.ndarray, im_info, cfg) -> jnp.ndarray:
         cols < im_info[:, 1, None, None, None]
     )
     return out * mask
+
+
+class _ConvKernel(nn.Module):
+    """Parameter bank declaring an nn.Conv-compatible HWIO kernel.
+
+    Same param name ("kernel"), shape, dtype, and initializer as the
+    nn.Conv the unfused path builds, so a module that swaps between
+    fused and unfused conv+BN keeps a byte-identical param tree."""
+
+    features: int
+    kernel: int
+
+    @nn.compact
+    def __call__(self, cin: int) -> jnp.ndarray:
+        return self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (self.kernel, self.kernel, cin, self.features),
+            jnp.float32,
+        )
+
+
+class _BNParams(nn.Module):
+    """Parameter bank declaring FrozenBatchNorm's four tensors."""
+
+    @nn.compact
+    def __call__(self, c: int):
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        mean = self.param("mean", nn.initializers.zeros, (c,), jnp.float32)
+        var = self.param("var", nn.initializers.ones, (c,), jnp.float32)
+        return scale, bias, mean, var
+
+
+def fused_conv_bn(
+    x: jnp.ndarray,
+    features: int,
+    kernel: int,
+    stride: int,
+    dtype: Any,
+    conv_name: str,
+    bn_name: str,
+    eps: float = BN_EPS,
+) -> jnp.ndarray:
+    """conv → FrozenBatchNorm with the BN affine folded into the kernel.
+
+    Algebraically identical to the unfused pair — y = conv(x, W)·mul + add
+    = conv(x, W·mul) + add since mul is per-output-channel — but the
+    fold happens on the (tiny) weight tensor in f32 instead of the (huge)
+    activation tensor, removing the activation-side multiply and its
+    backward twin entirely.  Gradients flow to W and the BN affine
+    through the fold arithmetic unchanged; mean/var stay stop_gradient'd
+    exactly as in FrozenBatchNorm.  Param paths ({conv_name}/kernel,
+    {bn_name}/{scale,bias,mean,var}) match the unfused modules, so
+    checkpoints and the pretrained importer work with either path.
+
+    Call only inside an @nn.compact parent (instantiates param banks)."""
+    w = _ConvKernel(features, kernel, name=conv_name)(x.shape[-1])
+    scale, bias, mean, var = _BNParams(name=bn_name)(features)
+    mean = jax.lax.stop_gradient(mean)
+    var = jax.lax.stop_gradient(var)
+    mul = scale * jax.lax.rsqrt(var + eps)            # (cout,) f32
+    w = (w * mul[None, None, None, :]).astype(dtype)
+    add = (bias - mean * mul).astype(dtype)
+    pad = (kernel - 1) // 2
+    y = jax.lax.conv_general_dilated(
+        x.astype(dtype),
+        w,
+        (stride, stride),
+        [(pad, pad), (pad, pad)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + add
+
+
+def make_conv_bn(fold: bool, dtype: Any):
+    """→ ``cbn(x, features, kernel, stride, conv_name, bn_name)`` — ONE
+    conv→frozen-BN wiring shared by the folded and unfused graphs, so a
+    structural edit (stride placement, shortcut condition) can never be
+    made on one side only.  Param paths are identical either way."""
+    if fold:
+        def cbn(x, features, kernel, stride, conv_name, bn_name):
+            return fused_conv_bn(
+                x, features, kernel, stride, dtype, conv_name, bn_name
+            )
+    else:
+        def cbn(x, features, kernel, stride, conv_name, bn_name):
+            y = conv(features, kernel, stride, dtype, name=conv_name)(x)
+            return FrozenBatchNorm(dtype=dtype, name=bn_name)(y)
+    return cbn
 
 
 def conv(

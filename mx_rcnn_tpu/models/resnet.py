@@ -22,7 +22,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from mx_rcnn_tpu.models.layers import FrozenBatchNorm, conv
+from mx_rcnn_tpu.models.layers import conv, make_conv_bn
 
 _BLOCKS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
 
@@ -70,21 +70,19 @@ class Bottleneck(nn.Module):
     filters: int
     stride: int = 1
     dtype: Any = jnp.float32
+    fold_bn: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cbn = make_conv_bn(self.fold_bn, self.dtype)
+        y = cbn(x, self.filters, 1, self.stride, "conv1", "bn1")
+        y = nn.relu(y)
+        y = cbn(y, self.filters, 3, 1, "conv2", "bn2")
+        y = nn.relu(y)
+        y = cbn(y, self.filters * 4, 1, 1, "conv3", "bn3")
         residual = x
-        y = conv(self.filters, 1, self.stride, self.dtype, name="conv1")(x)
-        y = FrozenBatchNorm(dtype=self.dtype, name="bn1")(y)
-        y = nn.relu(y)
-        y = conv(self.filters, 3, 1, self.dtype, name="conv2")(y)
-        y = FrozenBatchNorm(dtype=self.dtype, name="bn2")(y)
-        y = nn.relu(y)
-        y = conv(self.filters * 4, 1, 1, self.dtype, name="conv3")(y)
-        y = FrozenBatchNorm(dtype=self.dtype, name="bn3")(y)
         if residual.shape != y.shape:
-            residual = conv(self.filters * 4, 1, self.stride, self.dtype, name="sc")(x)
-            residual = FrozenBatchNorm(dtype=self.dtype, name="sc_bn")(residual)
+            residual = cbn(x, self.filters * 4, 1, self.stride, "sc", "sc_bn")
         return nn.relu(y + residual)
 
 
@@ -93,6 +91,7 @@ class ResNetStage(nn.Module):
     num_units: int
     stride: int
     dtype: Any = jnp.float32
+    fold_bn: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -101,6 +100,7 @@ class ResNetStage(nn.Module):
                 self.filters,
                 stride=self.stride if i == 0 else 1,
                 dtype=self.dtype,
+                fold_bn=self.fold_bn,
                 name=f"unit{i + 1}",
             )(x)
         return x
@@ -121,6 +121,9 @@ class ResNetBackbone(nn.Module):
     # gradient is stopped (their params are frozen via the FIXED_PARAMS
     # optimizer mask; the stop makes XLA skip their backward entirely)
     frozen_prefix: int = 0
+    # fold the frozen-BN affines into the conv kernels (exact rewrite;
+    # same param tree — see layers.fused_conv_bn)
+    fold_bn: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray):
@@ -130,17 +133,23 @@ class ResNetBackbone(nn.Module):
             return jax.lax.stop_gradient(x) if self.frozen_prefix == idx else x
 
         x = x.astype(self.dtype)
-        x = conv(64, 7, 2, self.dtype, name="conv0")(x)
-        x = FrozenBatchNorm(dtype=self.dtype, name="bn0")(x)
+        x = make_conv_bn(self.fold_bn, self.dtype)(x, 64, 7, 2, "conv0", "bn0")
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         x = boundary(x, 1)
-        c2 = boundary(ResNetStage(64, blocks[0], 1, self.dtype, name="stage1")(x), 2)
-        c3 = boundary(ResNetStage(128, blocks[1], 2, self.dtype, name="stage2")(c2), 3)
-        c4 = boundary(ResNetStage(256, blocks[2], 2, self.dtype, name="stage3")(c3), 4)
+
+        def stage(filters, n_units, stride, name):
+            return ResNetStage(
+                filters, n_units, stride, self.dtype,
+                fold_bn=self.fold_bn, name=name,
+            )
+
+        c2 = boundary(stage(64, blocks[0], 1, "stage1")(x), 2)
+        c3 = boundary(stage(128, blocks[1], 2, "stage2")(c2), 3)
+        c4 = boundary(stage(256, blocks[2], 2, "stage3")(c3), 4)
         if not self.return_pyramid:
             return c4
-        c5 = ResNetStage(512, blocks[3], 2, self.dtype, name="stage4")(c4)
+        c5 = stage(512, blocks[3], 2, "stage4")(c4)
         return c2, c3, c4, c5
 
 
@@ -153,9 +162,11 @@ class ResNetTopHead(nn.Module):
 
     depth: int = 101
     dtype: Any = jnp.float32
+    fold_bn: bool = False
 
     @nn.compact
     def __call__(self, rois_feat: jnp.ndarray) -> jnp.ndarray:
         blocks = _BLOCKS[self.depth]
-        x = ResNetStage(512, blocks[3], 2, self.dtype, name="stage4")(rois_feat)
+        x = ResNetStage(512, blocks[3], 2, self.dtype,
+                        fold_bn=self.fold_bn, name="stage4")(rois_feat)
         return jnp.mean(x, axis=(1, 2))

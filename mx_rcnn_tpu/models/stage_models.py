@@ -60,9 +60,11 @@ def build_backbone(
         n = frozen_prefix_len(fixed, VGG_BLOCK_ORDER)
         return VGGBackbone(dtype=dtype, frozen_prefix=n), VGGTopHead(dtype=dtype)
     n = frozen_prefix_len(fixed, RESNET_BLOCK_ORDER, requires=("bn",))
+    fold = cfg.network.FOLD_BN
     return (
-        ResNetBackbone(depth=cfg.network.depth, dtype=dtype, frozen_prefix=n),
-        ResNetTopHead(depth=cfg.network.depth, dtype=dtype),
+        ResNetBackbone(depth=cfg.network.depth, dtype=dtype, frozen_prefix=n,
+                       fold_bn=fold),
+        ResNetTopHead(depth=cfg.network.depth, dtype=dtype, fold_bn=fold),
     )
 
 

@@ -82,12 +82,31 @@ def _matrices_for_roi(rois_ref, b, r, hf: int, wf: int, pooled, s: int, scale: f
     return my, mx
 
 
-def _fwd_kernel(rois_ref, feat_ref, out_ref, *, pooled, s, scale):
-    b, r = pl.program_id(0), pl.program_id(2)
+def _fwd_kernel(rois_ref, feat_ref, out_ref, *, pooled, s, scale, rblk):
+    """Blocked forward: RBLK rois per grid step.
+
+    The W-contraction (the majority of the flops — W ≥ H in every
+    landscape bucket) runs once on a STACKED (RBLK·PW, W) interpolation
+    matrix: M=112 rows at the default rblk=8/pw=14 instead of 14, so the
+    MXU's 128-row tiles are ~90% occupied instead of ~11%.  The
+    H-contraction needs a different My per roi on the non-contracted
+    side, so it stays per-roi; putting the SHORTER spatial axis (H) on
+    the per-roi side minimizes that tail, and its (PH, H)@(H, PW, CB)
+    form emits (PH, PW, CB) directly — no in-kernel transpose.  Blocking
+    the per-roi side would need a block-diagonal My whose 7/8 zero flops
+    exactly cancel the utilization win."""
+    b, rb = pl.program_id(0), pl.program_id(2)
     hf, wf = feat_ref.shape[1], feat_ref.shape[2]
-    my, mx = _matrices_for_roi(rois_ref, b, r, hf, wf, pooled, s, scale)
+    ph, pw = pooled
+    mys, mxs = [], []
+    for k in range(rblk):
+        my, mx = _matrices_for_roi(
+            rois_ref, b, rb * rblk + k, hf, wf, pooled, s, scale
+        )
+        mys.append(my)
+        mxs.append(mx)
+    mx_blk = jnp.concatenate(mxs, axis=0)                            # (RB*PW, W)
     feat = feat_ref[0]                                               # (H, W, CB)
-    # rows: (PH, W, CB) = contract H;   out: (PH, PW, CB) = contract W
     # Precision follows the graph's dtype: a bf16 training graph gets
     # single-pass bf16 dots with f32 accumulation (the same contract as
     # every conv around it); an f32 graph (eval parity) keeps 6-pass
@@ -95,68 +114,87 @@ def _fwd_kernel(rois_ref, feat_ref, out_ref, *, pooled, s, scale):
     # ~1e-5, not ~1e-3.
     if feat.dtype == jnp.bfloat16:
         prec = jax.lax.Precision.DEFAULT
-        my, mx = my.astype(jnp.bfloat16), mx.astype(jnp.bfloat16)
-
-        def dot1(a, bmat, dims):
-            return jax.lax.dot_general(
-                a, bmat, dims, preferred_element_type=jnp.float32,
-                precision=prec,
-            )
-
-        rows = dot1(my, feat, (((1,), (0,)), ((), ()))).astype(jnp.bfloat16)
-        out = dot1(mx, rows, (((1,), (1,)), ((), ())))
+        mx_blk = mx_blk.astype(jnp.bfloat16)
+        mys = [m.astype(jnp.bfloat16) for m in mys]
     else:
-        rows = jax.lax.dot_general(
-            my, feat.astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        out = jax.lax.dot_general(
-            mx, rows, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )                                                            # (PW, PH, CB)
-    out_ref[0, 0] = out.transpose(1, 0, 2).astype(out_ref.dtype)
+        prec = jax.lax.Precision.HIGHEST
+        feat = feat.astype(jnp.float32)
+
+    # W first on the stacked matrix, H per-roi: the per-roi tail then
+    # contracts the SHORTER axis (H) and emits (PH, PW, CB) directly —
+    # no in-kernel transpose
+    cols = jax.lax.dot_general(
+        mx_blk, feat, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=prec,
+    )                                                                # (RB*PW, H, CB)
+    if feat.dtype == jnp.bfloat16:
+        cols = cols.astype(jnp.bfloat16)
+    for k in range(rblk):
+        out_k = jax.lax.dot_general(
+            mys[k], cols[k * pw:(k + 1) * pw],
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )                                                            # (PH, PW, CB)
+        out_ref[0, k] = out_k.astype(out_ref.dtype)
 
 
-def _bwd_kernel(rois_ref, g_ref, dfeat_ref, *, pooled, s, scale):
-    """dfeat is accumulated across the roi sweep in f32 (the out_shape is
-    forced f32 regardless of feat dtype — 128 sequential bf16 adds would
-    swallow small per-roi contributions); cast back outside the kernel.
+def _bwd_kernel(rois_ref, g_ref, dfeat_ref, *, pooled, s, scale, rblk):
+    """Blocked backward: RBLK rois per grid step.
+
+    dfeat is accumulated across the roi-block sweep in f32 (the
+    out_shape is forced f32 regardless of feat dtype — sequential bf16
+    adds would swallow small per-roi contributions); cast back outside
+    the kernel.
+
+    d = Σ_k Mxᵀ_k @ (Myᵀ_k @ g_k) restructured so the roi sum rides the
+    contraction: the per-roi half (t_k = Myᵀ_k @ g_k, K=PH=14) stays
+    small, but the second half stacks t_k into (W, RB·PW, CB)-shaped U
+    and contracts K=RB·PW=112 against the stacked Mx — one matmul sums
+    all RBLK rois, with ~90% K-tile occupancy instead of ~11% and 8×
+    fewer accumulator read-modify-writes.
 
     Two deliberate asymmetries vs the forward kernel: the accumulator is
-    laid out TRANSPOSED, (W, H, CB) — the second dot emits that order,
+    laid out TRANSPOSED, (W, H, CB) — the stacked dot emits that order,
     and one XLA transpose of the final (B, W, H, C) outside the kernel
-    replaces B·R·(C/CB) in-kernel transposes (measured 35 ms → a few ms
-    on the flagship step).  Precision mirrors the forward's dtype
-    branch: bf16 cotangents (the bf16 training graph) take default MXU
-    passes — 6-pass HIGHEST buys nothing the rest of that backward
-    has — while f32 cotangents (COMPUTE_DTYPE=float32 runs) keep
-    HIGHEST so gradients round at ~1e-5, not bf16-mantissa ~1e-3."""
-    b, r = pl.program_id(0), pl.program_id(2)
+    replaces per-step in-kernel transposes (measured 35 ms → a few ms on
+    the flagship step).  Precision mirrors the forward's dtype branch:
+    bf16 cotangents (the bf16 training graph) take default MXU passes —
+    6-pass HIGHEST buys nothing the rest of that backward has — while
+    f32 cotangents (COMPUTE_DTYPE=float32 runs) keep HIGHEST so
+    gradients round at ~1e-5, not bf16-mantissa ~1e-3."""
+    b, rb = pl.program_id(0), pl.program_id(2)
     wf, hf = dfeat_ref.shape[1], dfeat_ref.shape[2]
-    my, mx = _matrices_for_roi(rois_ref, b, r, hf, wf, pooled, s, scale)
+    ph, pw = pooled
     prec = (
         jax.lax.Precision.HIGHEST
         if g_ref.dtype == jnp.float32
         else jax.lax.Precision.DEFAULT
     )
-    g = g_ref[0, 0].astype(jnp.float32)                              # (PH, PW, CB)
-    # t: (H, PW, CB) = Myᵀ contract PH;  d: (W, H, CB) = Mxᵀ contract PW
-    t = jax.lax.dot_general(
-        my, g, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-        precision=prec,
-    )                                                                # (H, PW, CB)
+    ts, mxs = [], []
+    for k in range(rblk):
+        my, mx = _matrices_for_roi(
+            rois_ref, b, rb * rblk + k, hf, wf, pooled, s, scale
+        )
+        g = g_ref[0, k].astype(jnp.float32)                          # (PH, PW, CB)
+        # t_k: (H, PW, CB) = Myᵀ_k contract PH
+        ts.append(jax.lax.dot_general(
+            my, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        ))
+        mxs.append(mx)
+    mx_blk = jnp.concatenate(mxs, axis=0)                            # (RB*PW, W)
+    t_blk = jnp.concatenate(ts, axis=1)                              # (H, RB*PW, CB)
+    # d: (W, H, CB) = stacked Mxᵀ contract RB·PW — sums the roi block
     d = jax.lax.dot_general(
-        mx, t, (((0,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-        precision=prec,
-    )                                                                # (W, H, CB)
+        mx_blk, t_blk, (((0,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=prec,
+    )
 
-    @pl.when(r == 0)
+    @pl.when(rb == 0)
     def _():
         dfeat_ref[0] = d
 
-    @pl.when(r > 0)
+    @pl.when(rb > 0)
     def _():
         dfeat_ref[0] = dfeat_ref[0] + d
 
@@ -168,30 +206,79 @@ def _cblk(c: int, largest: int = 512) -> int:
     return c
 
 
-_VMEM_BUDGET = 5 * 2**20  # per resident feature block (of ~16MB total)
+# Per-step working-set budget.  The flagship bf16 C4 configs validated
+# on a real v5e hold 5.6 MB (fwd) / 6.9 MB (bwd) under this accounting
+# and compile+run; the historical over-commit (old 512-cap bwd,
+# ~13.7 MB accounted) failed scoped-VMEM allocation.  8 MB keeps every
+# hardware-validated config resident with margin for Mosaic's
+# double-buffering of the streamed g/out blocks (~1 MB) inside the
+# chip's ~16 MB.
+_VMEM_BUDGET = 8 * 2**20
+
+_RBLK = 8  # rois per grid step; M/K tiles go 14 → 112 of the MXU's 128
+
+
+def _resident_bytes(h: int, w: int, blk: int, esize: int) -> int:
+    """Worst-case VMEM bytes the blocked kernels hold per step: the
+    resident (H, W, blk) slab (feat dtype) or f32 accumulator PLUS the
+    f32 stacked roi-block intermediate — fwd's cols (RB·PW, H, blk) or
+    bwd's t_blk (H, RB·PW, blk), bounded by max(h, w) on the spatial
+    axis.  The pre-blocking heuristic counted only the slab; the
+    stacked intermediate is the same order of magnitude, so omitting it
+    would re-create exactly the silent over-commit the historical
+    512-cap comment records (fit check passes, Mosaic scoped-VMEM
+    allocation fails).  ``esize``: feat dtype bytes for the fwd slab; the
+    bwd accumulator is always f32, so bwd callers pass 4.
+
+    The stacked intermediate's spatial axis is H in both passes (the
+    kernels contract W on the stacked side), so portrait buckets
+    (H > W) genuinely hold the larger intermediate and size down to a
+    smaller cblk — that is the honest cost of the fixed W-stacked axis
+    order, not over-counting."""
+    pooled_stack = _RBLK * 14  # PH/PW ≤ 14 in every config
+    return (h * w * esize + pooled_stack * h * 4) * blk
 
 
 def fits_vmem(h: int, w: int, c: int) -> bool:
-    """True iff some channel block keeps the resident (H, W, cblk) f32
-    feature slab within the VMEM budget."""
-    return h * w * _cblk(c, largest=128) * 4 <= _VMEM_BUDGET
+    """True iff some channel block keeps the blocked kernels' per-step
+    working set (slab + stacked roi-block intermediate) in budget —
+    checked for the BACKWARD's f32 accumulator (the larger of the two
+    passes), so a map dispatched resident never OOMs in its grad."""
+    return _resident_bytes(h, w, _cblk(c, largest=128), 4) <= _VMEM_BUDGET
 
 
-def _cblk_fit(h: int, w: int, c: int, largest: int) -> int:
-    """Largest channel block whose (H, W, cblk) f32 slab fits the budget."""
+def _cblk_fit(h: int, w: int, c: int, largest: int, esize: int = 4) -> int:
+    """Largest channel block whose per-step working set fits the budget."""
     blk = _cblk(c, largest)
-    while blk > 128 and h * w * blk * 4 > _VMEM_BUDGET:
+    while blk > 128 and _resident_bytes(h, w, blk, esize) > _VMEM_BUDGET:
         blk //= 2
     return blk
+
+
+def _pad_rois(rois, rblk):
+    """(B, R, 4) → ((B, 4, Rp) SMEM layout, Rp) with R padded to rblk.
+
+    Pad rois are all-zero boxes — degenerate but numerically safe
+    (length floors at 1 in _sample_coords), and their outputs are
+    sliced away / their cotangents are structurally zero."""
+    r = rois.shape[1]
+    rp = -(-r // rblk) * rblk
+    rois_t = rois.astype(jnp.float32).transpose(0, 2, 1)
+    if rp != r:
+        rois_t = jnp.pad(rois_t, ((0, 0), (0, 0), (0, rp - r)))
+    return rois_t, rp
 
 
 def _roi_align_fwd_impl(feat, rois, pooled, scale, s, interpret):
     b, hf, wf, c = feat.shape
     r = rois.shape[1]
-    cblk = _cblk_fit(hf, wf, c, largest=512)
-    grid = (b, c // cblk, r)
-    kernel = partial(_fwd_kernel, pooled=pooled, s=s, scale=scale)
-    return pl.pallas_call(
+    # 256 cap: the blocked (RB·PW, H, CB) f32 cols intermediate shares
+    # VMEM with the resident feature slab
+    cblk = _cblk_fit(hf, wf, c, largest=256, esize=feat.dtype.itemsize)
+    rois_t, rp = _pad_rois(rois, _RBLK)
+    grid = (b, c // cblk, rp // _RBLK)
+    kernel = partial(_fwd_kernel, pooled=pooled, s=s, scale=scale, rblk=_RBLK)
+    out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -203,23 +290,27 @@ def _roi_align_fwd_impl(feat, rois, pooled, scale, s, interpret):
                 ),
             ],
             out_specs=pl.BlockSpec(
-                (1, 1, pooled[0], pooled[1], cblk),
+                (1, _RBLK, pooled[0], pooled[1], cblk),
                 lambda bb, cb, rr, rois_ref: (bb, rr, 0, 0, cb),
             ),
         ),
-        out_shape=jax.ShapeDtypeStruct((b, r, pooled[0], pooled[1], c), feat.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, rp, pooled[0], pooled[1], c), feat.dtype),
         interpret=interpret,
-    )(rois.astype(jnp.float32).transpose(0, 2, 1), feat)
+    )(rois_t, feat)
+    return out[:, :r] if rp != r else out
 
 
 def _roi_align_bwd_impl(feat_shape, feat_dtype, rois, g, pooled, scale, s, interpret):
     b, hf, wf, c = feat_shape
     r = rois.shape[1]
-    # 256 cap: the f32 accumulator block + its transpose scratch must fit
-    # the scoped-VMEM budget (512 OOMs at 600x1000/stride-16 shapes)
-    cblk = _cblk_fit(hf, wf, c, largest=256)
-    grid = (b, c // cblk, r)
-    kernel = partial(_bwd_kernel, pooled=pooled, s=s, scale=scale)
+    # 256 cap: the f32 accumulator block + the stacked t intermediate
+    # must fit the scoped-VMEM budget (512 OOMs at 600x1000/stride-16)
+    cblk = _cblk_fit(hf, wf, c, largest=256, esize=4)
+    rois_t, rp = _pad_rois(rois, _RBLK)
+    if rp != r:
+        g = jnp.pad(g, ((0, 0), (0, rp - r)) + ((0, 0),) * (g.ndim - 2))
+    grid = (b, c // cblk, rp // _RBLK)
+    kernel = partial(_bwd_kernel, pooled=pooled, s=s, scale=scale, rblk=_RBLK)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -227,7 +318,7 @@ def _roi_align_bwd_impl(feat_shape, feat_dtype, rois, g, pooled, scale, s, inter
             grid=grid,
             in_specs=[
                 pl.BlockSpec(
-                    (1, 1, pooled[0], pooled[1], cblk),
+                    (1, _RBLK, pooled[0], pooled[1], cblk),
                     lambda bb, cb, rr, rois_ref: (bb, rr, 0, 0, cb),
                 ),
             ],
@@ -239,7 +330,7 @@ def _roi_align_bwd_impl(feat_shape, feat_dtype, rois, g, pooled, scale, s, inter
         # (B, W, H, C): the kernel accumulates transposed (see docstring)
         out_shape=jax.ShapeDtypeStruct((b, wf, hf, c), jnp.float32),
         interpret=interpret,
-    )(rois.astype(jnp.float32).transpose(0, 2, 1), g)
+    )(rois_t, g)
     return out.swapaxes(1, 2).astype(feat_dtype)
 
 

@@ -165,12 +165,20 @@ def extract_roi_features_batched(
     pooled: tuple,
     spatial_scale: float,
     sample_ratio: int = 2,
+    fwd_only: bool = False,
 ) -> jnp.ndarray:
     """(B, H, W, C) × (B, R, 4) → (B, R, ph, pw, C).
 
     On TPU backends the roi_align path uses the Pallas MXU kernel
     (``ops/pallas/roi_align.py``); elsewhere (and for roi_pool) the
     chunked-gather jnp implementations under vmap.
+
+    ``fwd_only``: callers that never differentiate this op (eval /
+    test_forward) should set it.  For over-VMEM maps the streaming
+    kernel only beats the chunked gather when the backward pass is in
+    play (real-TPU P2-shape timings, scripts/probe_stream_kernel.py:
+    fwd 160 vs 121 ms, fwd+bwd 108 vs 326 ms), so forward-only graphs
+    take the gather path there.
     """
     from mx_rcnn_tpu.utils.platform import use_pallas
 
@@ -188,9 +196,14 @@ def extract_roi_features_batched(
             return roi_align_pallas(
                 feat, rois, pooled, spatial_scale, sample_ratio
             )
-        from mx_rcnn_tpu.ops.pallas.roi_align_stream import roi_align_stream
+        if not fwd_only:
+            from mx_rcnn_tpu.ops.pallas.roi_align_stream import (
+                roi_align_stream,
+            )
 
-        return roi_align_stream(feat, rois, pooled, spatial_scale, sample_ratio)
+            return roi_align_stream(
+                feat, rois, pooled, spatial_scale, sample_ratio
+            )
     return jax.vmap(
         lambda f, r: extract_roi_features(
             f, r, mode, pooled, spatial_scale, sample_ratio

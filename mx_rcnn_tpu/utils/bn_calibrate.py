@@ -54,6 +54,20 @@ def calibrate_frozen_bn(model, params: Dict, batch: Dict) -> Dict:
     empirically takes the flagship gate from O(1e2) activation std to
     O(10), which is what SGD stability needs; the variance floor below
     caps any single BN's gain at 5× as the backstop."""
+    cfg = getattr(model, "cfg", None)
+    if cfg is not None and getattr(cfg.network, "FOLD_BN", False):
+        # the folded graph never materializes the pre-BN conv output
+        # (layers.fused_conv_bn computes conv(x, W·mul) + add directly),
+        # so capture on an UNFUSED twin — same param tree by design
+        import dataclasses
+
+        from mx_rcnn_tpu.models import build_model
+
+        model = build_model(
+            cfg.replace(
+                network=dataclasses.replace(cfg.network, FOLD_BN=False)
+            )
+        )
     _, state = model.apply(
         {"params": params},
         batch["images"],
@@ -82,6 +96,11 @@ def calibrate_frozen_bn(model, params: Dict, batch: Dict) -> Dict:
             f"no captured conv output {conv_path} for BN {bn_path}"
         )
         x = jnp.asarray(conv_out[conv_path], jnp.float32)
+        # guard against capturing a parameter bank instead of an
+        # activation (the folded graph's conv "outputs" are kernels)
+        assert x.shape[0] == batch["images"].shape[0], (
+            f"captured {conv_path} is not a batch activation: {x.shape}"
+        )
         axes = tuple(range(x.ndim - 1))
         mean = jnp.mean(x, axis=axes)
         var = jnp.var(x, axis=axes)

@@ -1,0 +1,182 @@
+"""Round-4 probe, take 2: per-op timings with in-jit chaining.
+
+probe_backbone.py timed each op as its own jitted dispatch; on the axon
+relay every dispatch carries ~20 ms of host/tunnel latency, so small ops
+all measured ~20 ms and the per-stage numbers summed to 3x the whole
+backbone.  This probe chains N applications of the op inside ONE jit
+(lax.fori_loop, input perturbed by the loop index so XLA cannot hoist
+the body) and reports (t(N) - t(1)) / (N - 1): pure device time per
+application, dispatch overhead cancelled.
+
+Usage: python scripts/probe_backbone2.py [variant ...]
+Variants: base stages conv0 folded all
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from mx_rcnn_tpu.utils.platform import enable_compile_cache
+
+enable_compile_cache()
+
+B, H, W = 8, 608, 1024
+DTYPE = jnp.bfloat16
+N = 9  # chained applications
+
+
+def chained(fn, x, n):
+    """Scalar-result jit that applies fn n times to x (loop-dependent)."""
+
+    def run(p, xx):
+        def body(i, acc):
+            xi = xx + (i.astype(xx.dtype) * xx.dtype.type(1e-30))
+            return acc + fn(p, xi)
+
+        return lax.fori_loop(0, n, body, jnp.float32(0.0))
+
+    return jax.jit(run)
+
+
+def timeit(fn, *args, iters=6, warmup=2):
+    for _ in range(warmup):
+        r = fn(*args)
+    _ = float(jnp.asarray(r).ravel()[0])  # relay-safe sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args)
+    _ = float(jnp.asarray(r).ravel()[0])
+    return (time.perf_counter() - t0) / iters * 1000
+
+
+def bench_op(tag, fn, params, x):
+    t1 = timeit(chained(fn, x, 1), params, x)
+    tn = timeit(chained(fn, x, N), params, x)
+    per = (tn - t1) / (N - 1)
+    print(f"{tag:<44s} {per:8.2f} ms  (t1={t1:.1f} tN={tn:.1f})",
+          flush=True)
+    return per
+
+
+def main():
+    variants = sys.argv[1:] or ["all"]
+    if "all" in variants:
+        variants = ["base", "stages", "conv0", "folded"]
+
+    from mx_rcnn_tpu.models.resnet import ResNetBackbone, ResNetStage
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(B, H, W, 3).astype(np.float32))
+
+    bb = ResNetBackbone(depth=101, dtype=DTYPE, frozen_prefix=2)
+    params = bb.init(jax.random.key(0), x[:1])["params"]
+
+    def fwd_scalar(p, xx):
+        return bb.apply({"params": p}, xx).astype(jnp.float32).sum()
+
+    def bwd_scalar(p, xx):
+        g = jax.grad(fwd_scalar)(p, xx)
+        return jax.tree_util.tree_reduce(
+            lambda a, l: a + l.astype(jnp.float32).sum(), g, jnp.float32(0)
+        )
+
+    if "base" in variants:
+        bench_op("backbone fwd", fwd_scalar, params, x)
+        bench_op("backbone fwd+bwd", bwd_scalar, params, x)
+
+    if "stages" in variants:
+        import flax.linen as nn
+
+        from mx_rcnn_tpu.models.layers import FrozenBatchNorm, conv
+
+        class Conv0(nn.Module):
+            @nn.compact
+            def __call__(self, xx):
+                xx = xx.astype(DTYPE)
+                xx = conv(64, 7, 2, DTYPE, name="conv0")(xx)
+                xx = FrozenBatchNorm(dtype=DTYPE, name="bn0")(xx)
+                xx = nn.relu(xx)
+                return nn.max_pool(
+                    xx, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
+                )
+
+        c0 = Conv0()
+        p0 = {"conv0": params["conv0"], "bn0": params["bn0"]}
+        bench_op(
+            "conv0+bn+pool fwd",
+            lambda p, xx: c0.apply({"params": p}, xx)
+            .astype(jnp.float32).sum(),
+            p0, x,
+        )
+        y = jax.jit(lambda p, xx: c0.apply({"params": p}, xx))(p0, x)
+
+        blocks = {"stage1": (64, 3, 1), "stage2": (128, 4, 2),
+                  "stage3": (256, 23, 2)}
+        for name, (filt, n, stride) in blocks.items():
+            st = ResNetStage(filt, n, stride, DTYPE, name=name)
+            sp = params[name]
+
+            def sf(p, xx, st=st):
+                return st.apply({"params": p}, xx).astype(jnp.float32).sum()
+
+            def sb(p, xx, st=st, sf=sf):
+                g = jax.grad(sf)(p, xx)
+                return jax.tree_util.tree_reduce(
+                    lambda a, l: a + l.astype(jnp.float32).sum(), g,
+                    jnp.float32(0),
+                )
+
+            bench_op(f"{name} fwd (in {y.shape[1]}x{y.shape[2]})", sf, sp, y)
+            bench_op(f"{name} fwd+bwd", sb, sp, y)
+            y = jax.jit(lambda p, xx, st=st: st.apply({"params": p}, xx))(
+                sp, y
+            )
+
+    if "conv0" in variants:
+        k7 = jnp.asarray(rng.rand(7, 7, 3, 64).astype(np.float32) * 0.01,
+                         DTYPE)
+
+        def plain(_, xx):
+            return lax.conv_general_dilated(
+                xx.astype(DTYPE), k7, (2, 2), [(3, 3), (3, 3)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ).astype(jnp.float32).sum()
+
+        bench_op("plain conv0 7x7s2 C3 fwd", plain, None, x)
+
+        k4 = jnp.asarray(rng.rand(4, 4, 12, 64).astype(np.float32) * 0.01,
+                         DTYPE)
+
+        def s2d(_, xx):
+            v = xx.reshape(B, H // 2, 2, W // 2, 2, 3)
+            v = v.transpose(0, 1, 3, 2, 4, 5).reshape(B, H // 2, W // 2, 12)
+            return lax.conv_general_dilated(
+                v.astype(DTYPE), k4, (1, 1), [(2, 1), (2, 1)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ).astype(jnp.float32).sum()
+
+        bench_op("s2d conv0 4x4s1 C12 fwd", s2d, None, x)
+
+    if "folded" in variants:
+        bbf = ResNetBackbone(depth=101, dtype=DTYPE, frozen_prefix=2,
+                             fold_bn=True)
+
+        def ffwd(p, xx):
+            return bbf.apply({"params": p}, xx).astype(jnp.float32).sum()
+
+        def fbwd(p, xx):
+            g = jax.grad(ffwd)(p, xx)
+            return jax.tree_util.tree_reduce(
+                lambda a, l: a + l.astype(jnp.float32).sum(), g,
+                jnp.float32(0),
+            )
+
+        bench_op("folded-BN backbone fwd", ffwd, params, x)
+        bench_op("folded-BN backbone fwd+bwd", fbwd, params, x)
+
+
+if __name__ == "__main__":
+    main()
