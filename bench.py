@@ -26,7 +26,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--network", default="resnet",
-        choices=["resnet", "resnet50", "resnet_fpn", "mask_resnet_fpn"],
+        choices=["resnet", "resnet50", "resnet_fpn", "mask_resnet_fpn", "vgg"],
     )
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--iters", type=int, default=20)
@@ -97,6 +97,7 @@ def main():
         "resnet50": "resnet50_e2e",
         "resnet_fpn": "resnet50_fpn_e2e",
         "mask_resnet_fpn": "mask_resnet101_fpn_e2e",
+        "vgg": "vgg16_e2e",
     }[args.network]
     imgs_per_sec = b * iters / dt
     print(

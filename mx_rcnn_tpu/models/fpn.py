@@ -46,6 +46,7 @@ from mx_rcnn_tpu.models.rpn import RPNHead
 from mx_rcnn_tpu.ops.anchors import shifted_anchors
 from mx_rcnn_tpu.ops.losses import (
     accuracy,
+    one_hot_select,
     softmax_cross_entropy,
     weighted_smooth_l1,
 )
@@ -441,9 +442,9 @@ class FPNFasterRCNN(nn.Module):
             targets = (soft >= 0.5).astype(jnp.float32)
 
         cls = jnp.clip(samples.labels, 0)                         # (B, R)
-        sel = jnp.take_along_axis(
-            logits, cls[..., None, None, None], axis=-1
-        )[..., 0]                                                 # (B, R, S, S)
+        sel = one_hot_select(
+            logits, cls[..., None, None]
+        )                                                         # (B, R, S, S)
         bce = optax_sigmoid_bce(sel, targets)
         per_roi = bce.mean(axis=(-1, -2))                         # (B, R)
         loss = (per_roi * fg).sum() / jnp.maximum(fg.sum(), 1.0)

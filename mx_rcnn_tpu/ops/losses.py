@@ -15,6 +15,7 @@ keep the reference's effective learning-rate semantics.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -46,6 +47,18 @@ def weighted_smooth_l1(
     return jnp.sum(weight * smooth_l1(pred, target, sigma)) / norm
 
 
+def one_hot_select(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """``x[..., idx]`` over the minor axis WITHOUT a gather.
+
+    take_along_axis lowers to a serialized TPU gather (1.45 ms/step on
+    the flagship trace for the RPN CE's 175k rows, plus a scatter in its
+    backward); the broadcast-compare multiply-sum stays a fused VPU
+    pass.  Exact: one match per row, the rest contribute zero.  ``idx``
+    broadcasts against ``x``'s leading dims."""
+    classes = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+    return jnp.sum(jnp.where(classes == idx[..., None], x, 0.0), -1)
+
+
 def softmax_cross_entropy(
     logits: jnp.ndarray,
     labels: jnp.ndarray,
@@ -61,10 +74,9 @@ def softmax_cross_entropy(
     logits = logits.astype(jnp.float32)
     valid = labels != ignore_label
     safe_labels = jnp.where(valid, labels, 0).astype(jnp.int32)
-    logz = jnp.log(jnp.sum(jnp.exp(logits - logits.max(-1, keepdims=True)), -1))
-    ll = jnp.take_along_axis(
-        logits - logits.max(-1, keepdims=True), safe_labels[..., None], axis=-1
-    )[..., 0]
+    shifted = logits - logits.max(-1, keepdims=True)
+    logz = jnp.log(jnp.sum(jnp.exp(shifted), -1))
+    ll = one_hot_select(shifted, safe_labels)
     nll = (logz - ll) * valid
     if norm is None:
         norm = jnp.maximum(valid.sum(), 1)

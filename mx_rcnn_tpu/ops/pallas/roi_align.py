@@ -122,7 +122,9 @@ def _fwd_kernel(rois_ref, feat_ref, out_ref, *, pooled, s, scale, rblk):
 
     # W first on the stacked matrix, H per-roi: the per-roi tail then
     # contracts the SHORTER axis (H) and emits (PH, PW, CB) directly —
-    # no in-kernel transpose
+    # no in-kernel transpose.  (A bf16 preferred_element_type would drop
+    # the f32 cols buffer and fit cblk=512, but tpu.matmul requires a
+    # 32-bit accumulator — Mosaic rejects it at lowering.)
     cols = jax.lax.dot_general(
         mx_blk, feat, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32, precision=prec,
@@ -234,7 +236,11 @@ def _resident_bytes(h: int, w: int, blk: int, esize: int) -> int:
     kernels contract W on the stacked side), so portrait buckets
     (H > W) genuinely hold the larger intermediate and size down to a
     smaller cblk — that is the honest cost of the fixed W-stacked axis
-    order, not over-counting."""
+    order, not over-counting.
+
+    The stacked intermediate is ALWAYS f32: tpu.matmul requires a
+    32-bit accumulator, so even bf16 graphs materialize fwd cols /
+    bwd t_blk in f32 before any cast."""
     pooled_stack = _RBLK * 14  # PH/PW ≤ 14 in every config
     return (h * w * esize + pooled_stack * h * 4) * blk
 

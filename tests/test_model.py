@@ -338,3 +338,35 @@ def test_fold_bn_exact_rewrite():
         denom = np.abs(np.asarray(u)).max() + 1e-6
         rel = np.abs(np.asarray(u) - np.asarray(v)).max() / denom
         assert rel < 5e-3, (jax.tree_util.keystr(path), rel)
+
+
+def test_softmax_ce_one_hot_matches_gather():
+    """The one-hot CE select (TPU-friendly; gathers serialize) must be
+    bit-equivalent to the take_along_axis formulation it replaced,
+    including ignore_label handling and the norm override."""
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.ops.losses import softmax_cross_entropy
+
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(64, 21).astype(np.float32) * 3)
+    labels = jnp.asarray(rng.randint(-1, 21, size=(64,)))
+
+    def reference(logits, labels, norm):
+        logits = logits.astype(np.float64)
+        valid = labels != -1
+        safe = np.where(valid, labels, 0).astype(np.int32)
+        shifted = logits - logits.max(-1, keepdims=True)
+        logz = np.log(np.exp(shifted).sum(-1))
+        ll = np.take_along_axis(shifted, safe[:, None], axis=-1)[:, 0]
+        return float(((logz - ll) * valid).sum() / norm)
+
+    got = float(softmax_cross_entropy(logits, labels, -1, 256.0))
+    want = reference(np.asarray(logits), np.asarray(labels), 256.0)
+    assert abs(got - want) < 1e-5, (got, want)
+
+    # default norm = valid count
+    got2 = float(softmax_cross_entropy(logits, labels))
+    nvalid = int((np.asarray(labels) != -1).sum())
+    want2 = reference(np.asarray(logits), np.asarray(labels), max(nvalid, 1))
+    assert abs(got2 - want2) < 1e-5, (got2, want2)
