@@ -127,14 +127,26 @@ def roi_pool(
     rois: jnp.ndarray,
     pooled: tuple = (7, 7),
     spatial_scale: float = 1.0 / 16.0,
-    chunk: int = 32,
+    chunk: int = 4,
 ) -> jnp.ndarray:
-    """(H, W, C) feature + (R, 4) rois → (R, ph, pw, C), max-pooled."""
+    """(H, W, C) feature + (R, 4) rois → (R, ph, pw, C), max-pooled.
+
+    ``chunk`` bounds the live (chunk, ph, H, W, C) masked-max
+    intermediate; at the flagship VGG shape (38×64×512 bf16, ph=7) each
+    chunked roi holds ~17 MB, so chunk=4 keeps the scan body ~70 MB.
+    The body is rematerialized (jax.checkpoint): reverse-mode through
+    lax.map otherwise SAVES each iteration's masked intermediate as a
+    scan residual — the full (chunks, chunk, ph, H, W, C) tensor,
+    16.6 GB at flagship across a batch of 8 (observed HBM OOM).
+    Callers must also not vmap over the batch dim (vmap batches the
+    scan body the same way); extract_roi_features_batched runs a
+    sequential batch loop for roi_pool."""
     r = rois.shape[0]
     pad = (-r) % chunk
     rois_p = jnp.concatenate([rois, jnp.zeros((pad, 4), rois.dtype)], axis=0)
     chunks = rois_p.reshape(-1, chunk, 4)
 
+    @jax.checkpoint
     def run_chunk(rs):
         return jax.vmap(lambda roi: _maxpool_one_roi(feat, roi, pooled, spatial_scale))(rs)
 
@@ -204,6 +216,19 @@ def extract_roi_features_batched(
             return roi_align_stream(
                 feat, rois, pooled, spatial_scale, sample_ratio
             )
+    if mode == "roi_pool":
+        # SEQUENTIAL over the batch: roi_pool's chunked masked-max under
+        # vmap batches the lax.map scan body into one
+        # (chunks, B, chunk, ph, H, W, C) allocation — 16.6 GB at the
+        # flagship VGG shape (observed HBM OOM).  lax.map keeps one
+        # image's chunk live at a time; roi counts are identical across
+        # the batch so the per-image compute is uniform.
+        return jax.lax.map(
+            lambda fr: extract_roi_features(
+                fr[0], fr[1], mode, pooled, spatial_scale, sample_ratio
+            ),
+            (feat, rois),
+        )
     return jax.vmap(
         lambda f, r: extract_roi_features(
             f, r, mode, pooled, spatial_scale, sample_ratio
