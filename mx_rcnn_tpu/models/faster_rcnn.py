@@ -84,7 +84,9 @@ class FasterRCNN(nn.Module):
             )
         )
 
-    def _roi_features(self, feat: jnp.ndarray, rois: jnp.ndarray) -> jnp.ndarray:
+    def _roi_features(
+        self, feat: jnp.ndarray, rois: jnp.ndarray, fwd_only: bool = False
+    ) -> jnp.ndarray:
         """(B, Hf, Wf, C) × (B, R, 4) → (B*R, D) head trunk features."""
         net = self.cfg.network
         pooled = extract_roi_features_batched(
@@ -94,6 +96,7 @@ class FasterRCNN(nn.Module):
             net.POOLED_SIZE,
             1.0 / net.RCNN_FEAT_STRIDE,
             net.ROI_SAMPLE_RATIO,
+            fwd_only=fwd_only,
         )
         b, r = pooled.shape[0], pooled.shape[1]
         return self.top_head(pooled.reshape((b * r,) + pooled.shape[2:]))
@@ -242,7 +245,7 @@ class FasterRCNN(nn.Module):
             )
         )(fg_scores, rpn_deltas, im_info)
 
-        trunk = self._roi_features(feat, props.rois)
+        trunk = self._roi_features(feat, props.rois, fwd_only=True)
         cls_logits, bbox_deltas = self.rcnn(trunk)
         b, r = images.shape[0], te.RPN_POST_NMS_TOP_N
         k = cfg.dataset.NUM_CLASSES

@@ -185,11 +185,14 @@ class FastRCNN(nn.Module):
         self.backbone, self.top_head = build_backbone(cfg, dtype, self.fixed_params)
         self.rcnn = RCNNHead(num_classes=cfg.dataset.NUM_CLASSES, dtype=dtype)
 
-    def _roi_features(self, feat: jnp.ndarray, rois: jnp.ndarray) -> jnp.ndarray:
+    def _roi_features(
+        self, feat: jnp.ndarray, rois: jnp.ndarray, fwd_only: bool = False
+    ) -> jnp.ndarray:
         net = self.cfg.network
         pooled = extract_roi_features_batched(
             feat, rois, net.ROI_MODE, net.POOLED_SIZE,
             1.0 / net.RCNN_FEAT_STRIDE, net.ROI_SAMPLE_RATIO,
+            fwd_only=fwd_only,
         )
         b, r = pooled.shape[0], pooled.shape[1]
         return self.top_head(pooled.reshape((b * r,) + pooled.shape[2:]))
@@ -214,7 +217,7 @@ class FastRCNN(nn.Module):
         feat = self.backbone(normalize_images(images, im_info, cfg))
 
         if not train:
-            trunk = self._roi_features(feat, proposals)
+            trunk = self._roi_features(feat, proposals, fwd_only=True)
             cls_logits, bbox_deltas = self.rcnn(trunk)
             r = proposals.shape[1]
             means, stds = bbox_denorm_vectors(cfg, k)

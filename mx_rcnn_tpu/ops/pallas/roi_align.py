@@ -137,6 +137,8 @@ def _fwd_kernel(rois_ref, feat_ref, out_ref, *, pooled, s, scale, rblk):
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec,
         )                                                            # (PH, PW, CB)
+        # per-roi sub-block stores: a single jnp.stack write measured
+        # 3% SLOWER end-to-end (the stack materializes a VMEM concat)
         out_ref[0, k] = out_k.astype(out_ref.dtype)
 
 

@@ -216,13 +216,15 @@ def extract_roi_features_batched(
             return roi_align_stream(
                 feat, rois, pooled, spatial_scale, sample_ratio
             )
-    if mode == "roi_pool":
-        # SEQUENTIAL over the batch: roi_pool's chunked masked-max under
-        # vmap batches the lax.map scan body into one
-        # (chunks, B, chunk, ph, H, W, C) allocation — 16.6 GB at the
-        # flagship VGG shape (observed HBM OOM).  lax.map keeps one
-        # image's chunk live at a time; roi counts are identical across
-        # the batch so the per-image compute is uniform.
+    if mode == "roi_pool" and not fwd_only:
+        # SEQUENTIAL over the batch: differentiating roi_pool's chunked
+        # masked-max under vmap saves every chunk's intermediate as a
+        # batched scan residual — one (chunks, B, chunk, ph, H, W, C)
+        # allocation, 16.6 GB at the flagship VGG shape (observed HBM
+        # OOM).  lax.map keeps one image's chunk live at a time.
+        # Forward-only graphs (eval) have no residuals, so they fall
+        # through to the batch-parallel vmap below: only one chunk's
+        # live body exists at a time (~0.5 GB at flagship).
         return jax.lax.map(
             lambda fr: extract_roi_features(
                 fr[0], fr[1], mode, pooled, spatial_scale, sample_ratio
