@@ -48,12 +48,19 @@ def main():
     from mx_rcnn_tpu.models import build_model
 
     cfg = generate_config(args.network, "PascalVOC")
-    # The perf configuration: bf16 compute (f32 params) rides the MXU, and
+    # The perf configuration: bf16 compute (f32 params) rides the MXU,
     # 8 images/chip/step amortize fixed per-step costs (measured: b1=29.9,
-    # b2=40.2, b4=44.6, b8=52.9 img/s on the C4 flagship).  entry()/dryrun
-    # keep f32 batch-1 for conservative compile/correctness checks.
+    # b2=40.2, b4=44.6, b8=52.9 img/s on the C4 flagship), and FOLD_BN
+    # folds the frozen-BN affines into the conv kernels (+2-3%; exact
+    # rewrite — default-off only because its fp-reassociation measurably
+    # shifted the f32 random-init gate trajectory, a non-issue at bf16
+    # where conv rounding dwarfs the fold delta; the TPU integration
+    # gates all passed with it on).  entry()/dryrun keep f32 batch-1
+    # defaults for conservative compile/correctness checks.
     cfg = cfg.replace(
-        network=dataclasses.replace(cfg.network, COMPUTE_DTYPE="bfloat16"),
+        network=dataclasses.replace(
+            cfg.network, COMPUTE_DTYPE="bfloat16", FOLD_BN=True
+        ),
         TRAIN=dataclasses.replace(cfg.TRAIN, BATCH_IMAGES=args.batch),
     )
     model = build_model(cfg)

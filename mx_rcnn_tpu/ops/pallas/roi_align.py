@@ -288,6 +288,11 @@ def _roi_align_fwd_impl(feat, rois, pooled, scale, s, interpret):
     kernel = partial(_fwd_kernel, pooled=pooled, s=s, scale=scale, rblk=_RBLK)
     out = pl.pallas_call(
         kernel,
+        # every fwd grid step writes a disjoint out block — declaring all
+        # three axes parallel lets Mosaic pipeline/overlap grid steps
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel"),
+        ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
@@ -321,6 +326,11 @@ def _roi_align_bwd_impl(feat_shape, feat_dtype, rois, g, pooled, scale, s, inter
     kernel = partial(_bwd_kernel, pooled=pooled, s=s, scale=scale, rblk=_RBLK)
     out = pl.pallas_call(
         kernel,
+        # batch/channel blocks are independent; the roi axis carries the
+        # accumulator read-modify-write and must stay sequential
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
