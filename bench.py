@@ -7,6 +7,10 @@ Default (driver) config: ResNet-101 C4 Faster R-CNN, the flagship.
 ``--network resnet_fpn`` / ``--network mask_resnet_fpn`` benchmark the
 BASELINE config-4/5 graphs (VERDICT r3 #3) with the same JSON contract.
 
+``--all`` (VERDICT r4 #4): bench every family in one process — one JSON
+line per family, plus ``--out FILE`` to write the driver-format artifact
+(``BENCH_families_rNN.json``) that replaces README-quoted perf prose.
+
 Baseline = the 30 imgs/sec/chip north-star target from BASELINE.json
 (the reference never published per-chip throughput; its GPU-era numbers
 were O(2-5) imgs/sec/GPU).
@@ -21,22 +25,21 @@ import numpy as np
 
 BASELINE_IMGS_PER_SEC_PER_CHIP = 30.0
 
+_METRIC_NAMES = {
+    "resnet": "resnet101_e2e",
+    "resnet50": "resnet50_e2e",
+    "resnet_fpn": "resnet50_fpn_e2e",
+    "mask_resnet_fpn": "mask_resnet101_fpn_e2e",
+    "vgg": "vgg16_e2e",
+}
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument(
-        "--network", default="resnet",
-        choices=["resnet", "resnet50", "resnet_fpn", "mask_resnet_fpn", "vgg"],
-    )
-    ap.add_argument("--batch", type=int, default=8)
-    ap.add_argument("--iters", type=int, default=20)
-    args = ap.parse_args()
+# the per-family artifact set: flagship + BASELINE configs 4/5 + VGG
+_ALL_FAMILIES = ("resnet", "resnet_fpn", "mask_resnet_fpn", "vgg")
 
+
+def bench_one(network: str, batch_images: int, iters: int) -> dict:
+    """Train-throughput measurement for one family; → the JSON record."""
     import jax
-
-    from mx_rcnn_tpu.utils.platform import enable_compile_cache
-
-    enable_compile_cache()
 
     from __graft_entry__ import _batch
     from mx_rcnn_tpu.config import generate_config
@@ -47,21 +50,21 @@ def main():
     )
     from mx_rcnn_tpu.models import build_model
 
-    cfg = generate_config(args.network, "PascalVOC")
+    cfg = generate_config(network, "PascalVOC")
     # The perf configuration: bf16 compute (f32 params) rides the MXU,
     # 8 images/chip/step amortize fixed per-step costs (measured: b1=29.9,
     # b2=40.2, b4=44.6, b8=52.9 img/s on the C4 flagship), and FOLD_BN
     # folds the frozen-BN affines into the conv kernels (+2-3%; exact
     # rewrite — default-off only because its fp-reassociation measurably
-    # shifted the f32 random-init gate trajectory, a non-issue at bf16
-    # where conv rounding dwarfs the fold delta; the TPU integration
-    # gates all passed with it on).  entry()/dryrun keep f32 batch-1
-    # defaults for conservative compile/correctness checks.
+    # shifted the f32 random-init gate trajectory; the bf16+FOLD_BN bench
+    # config has its own committed gate evidence, see PARITY.md round-5
+    # notes).  entry()/dryrun keep f32 batch-1 defaults for conservative
+    # compile/correctness checks.
     cfg = cfg.replace(
         network=dataclasses.replace(
             cfg.network, COMPUTE_DTYPE="bfloat16", FOLD_BN=True
         ),
-        TRAIN=dataclasses.replace(cfg.TRAIN, BATCH_IMAGES=args.batch),
+        TRAIN=dataclasses.replace(cfg.TRAIN, BATCH_IMAGES=batch_images),
     )
     model = build_model(cfg)
     h, w = cfg.SHAPE_BUCKETS[0]
@@ -90,7 +93,6 @@ def main():
     state, aux = step(state, batch, rng)
     float(aux["loss"])
 
-    iters = args.iters
     t0 = time.perf_counter()
     for _ in range(iters):
         state, aux = step(state, batch, rng)
@@ -99,24 +101,46 @@ def main():
     assert np.isfinite(float(aux["loss"]))
     dt = time.perf_counter() - t0
 
-    name = {
-        "resnet": "resnet101_e2e",
-        "resnet50": "resnet50_e2e",
-        "resnet_fpn": "resnet50_fpn_e2e",
-        "mask_resnet_fpn": "mask_resnet101_fpn_e2e",
-        "vgg": "vgg16_e2e",
-    }[args.network]
     imgs_per_sec = b * iters / dt
-    print(
-        json.dumps(
-            {
-                "metric": f"train_imgs_per_sec_per_chip_{name}",
-                "value": round(imgs_per_sec, 3),
-                "unit": "imgs/sec/chip",
-                "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
-            }
-        )
+    return {
+        "metric": f"train_imgs_per_sec_per_chip_{_METRIC_NAMES[network]}",
+        "value": round(imgs_per_sec, 3),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--network", default="resnet",
+        choices=sorted(_METRIC_NAMES),
     )
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument(
+        "--all", action="store_true",
+        help="bench every family; one JSON line each",
+    )
+    ap.add_argument(
+        "--out", default=None,
+        help="also write the records as a JSON array artifact",
+    )
+    args = ap.parse_args()
+
+    from mx_rcnn_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
+
+    families = _ALL_FAMILIES if args.all else (args.network,)
+    records = []
+    for network in families:
+        rec = bench_one(network, args.batch, args.iters)
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(records, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -60,8 +60,34 @@ class Predictor:
         self._fn = jax.jit(fwd)
 
     def predict(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        out = self._fn(self.params, batch)
-        return jax.device_get(out)
+        return jax.device_get(self.predict_async(batch))
+
+    def predict_async(self, batch: Dict[str, np.ndarray]):
+        """Dispatch the forward and return the ON-DEVICE outputs without
+        materializing them — jax's async dispatch returns as soon as the
+        computation is enqueued, so the caller can overlap the device
+        forward of batch N with host postprocess of batch N-1
+        (``jax.device_get`` forces when the results are needed).  This
+        is the device half of eval double-buffering; the host half is
+        the TestLoader prefetch thread (VERDICT r4 #8)."""
+        return self._fn(self.params, batch)
+
+
+def pipelined(predictor: Predictor, batches):
+    """1-deep dispatch pipeline shared by pred_eval / generate_proposals
+    / bench_eval: for each ``(payload, batch)`` in ``batches``, dispatch
+    batch N to the device, then materialize and yield
+    ``(payload, batch, outputs)`` for batch N-1 — the device forward
+    overlaps host postprocess plus the loader prefetch thread's assembly
+    of N+1."""
+    pending = None
+    for payload, batch in batches:
+        out = predictor.predict_async(batch)
+        if pending is not None:
+            yield pending[0], pending[1], jax.device_get(pending[2])
+        pending = (payload, batch, out)
+    if pending is not None:
+        yield pending[0], pending[1], jax.device_get(pending[2])
 
 
 def im_detect(
@@ -213,13 +239,16 @@ def pred_eval(
     if getattr(loader, "batch_size", 1) > 1:
         # batched device forwards (beyond-reference: the reference tester
         # is batch=1); dataset order is restored through the indices
-        for idxs, recs, batch in loader.iter_batched():
-            out = predictor.predict(batch)
+        for (idxs, recs), batch, out in pipelined(
+            predictor,
+            (((idxs, recs), batch) for idxs, recs, batch in loader.iter_batched()),
+        ):
             for k, (i, rec) in enumerate(zip(idxs, recs)):
                 process_image(i, rec, out, batch, k)
     else:
-        for i, (rec, batch) in enumerate(loader):
-            out = predictor.predict(batch)
+        for (i, rec), batch, out in pipelined(
+            predictor, (((i, rec), batch) for i, (rec, batch) in enumerate(loader))
+        ):
             process_image(i, rec, out, batch)
     if dump_path:
         with open(dump_path, "wb") as f:
@@ -251,8 +280,9 @@ def generate_proposals(
     ``.pkl`` dump consumed by ``load_proposal_roidb``).
     """
     proposals: List[Optional[np.ndarray]] = [None] * len(loader)
-    for idxs, recs, batch in loader.iter_batched():
-        out = predictor.predict(batch)
+    for idxs, batch, out in pipelined(
+        predictor, ((idxs, batch) for idxs, recs, batch in loader.iter_batched())
+    ):
         for k, i in enumerate(idxs):
             rois = out["rois"][k]
             valid = out["roi_valid"][k].astype(bool)

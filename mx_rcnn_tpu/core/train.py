@@ -101,6 +101,7 @@ def make_train_step(
     donate: bool = True,
     pmean_axis: str | None = None,
     accum_steps: int = 1,
+    fold_step_rng: bool = True,
 ):
     """Build the jitted train step.
 
@@ -114,6 +115,11 @@ def make_train_step(
     activations don't fit (the reference had no analog).  With per-image
     ``sample_seeds`` in the batch the update equals the unaccumulated
     step exactly (same linearity argument as DP equivalence).
+
+    ``fold_step_rng=False`` keeps the sampling rng CONSTANT across steps
+    (no fold_in of state.step): with per-image ``sample_seeds`` every
+    image's roi/anchor subsample is then identical every step — the
+    zero-label-churn ablation mode (scripts/probe_mask_churn.py).
     """
 
     def _grads_and_aux(params, batch, rng):
@@ -132,7 +138,8 @@ def make_train_step(
         return grads, aux
 
     def step_fn(state: TrainState, batch: Dict[str, jnp.ndarray], rng: jax.Array):
-        rng = jax.random.fold_in(rng, state.step)
+        if fold_step_rng:
+            rng = jax.random.fold_in(rng, state.step)
 
         if accum_steps == 1:
             grads, aux = _grads_and_aux(state.params, batch, rng)

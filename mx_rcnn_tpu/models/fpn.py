@@ -250,18 +250,22 @@ class FPNFasterRCNN(nn.Module):
         train: bool = False,
         sample_seeds: Optional[jnp.ndarray] = None,
         gt_masks: Optional[jnp.ndarray] = None,
+        proposals: Optional[jnp.ndarray] = None,
+        prop_valid: Optional[jnp.ndarray] = None,
     ):
         from mx_rcnn_tpu.models.layers import normalize_images
 
         images = normalize_images(images, im_info, self.cfg)
         if train:
             return self.train_forward(
-                images, im_info, gt_boxes, gt_valid, sample_seeds, gt_masks
+                images, im_info, gt_boxes, gt_valid, sample_seeds, gt_masks,
+                proposals, prop_valid,
             )
         return self.test_forward(images, im_info)
 
     def train_forward(self, images, im_info, gt_boxes, gt_valid,
-                      sample_seeds=None, gt_masks=None):
+                      sample_seeds=None, gt_masks=None,
+                      proposals=None, prop_valid=None):
         cfg = self.cfg
         t = cfg.TRAIN
         b = images.shape[0]
@@ -283,18 +287,30 @@ class FPNFasterRCNN(nn.Module):
         )(gt_boxes, gt_valid, im_info, keys[:, 0])
 
         fg_scores = jax.nn.softmax(rpn_logits, axis=-1)[..., 1]
-        n_levels = len(bounds) - 1
-        pre_per_level = max(t.RPN_PRE_NMS_TOP_N // n_levels, 256)
-        prop_boxes, prop_scores, prop_valid = jax.vmap(
-            lambda s, d, info: self._propose_multilevel(
-                s, d, anchors, bounds, info, pre_per_level,
-                t.RPN_POST_NMS_TOP_N, t.RPN_NMS_THRESH, t.RPN_MIN_SIZE,
+        if proposals is not None:
+            # frozen-proposal mode (ROIIter role / churn ablation): the
+            # RCNN+mask branches train on an EXTERNAL fixed proposal set
+            # instead of the live RPN's — RPN losses still train the RPN,
+            # but its drift no longer reshuffles roi labels step to step
+            if prop_valid is None:
+                raise ValueError(
+                    "frozen-proposal mode needs prop_valid alongside "
+                    "proposals (a padded-count validity mask)"
+                )
+            prop_boxes = proposals
+        else:
+            n_levels = len(bounds) - 1
+            pre_per_level = max(t.RPN_PRE_NMS_TOP_N // n_levels, 256)
+            prop_boxes, prop_scores, prop_valid = jax.vmap(
+                lambda s, d, info: self._propose_multilevel(
+                    s, d, anchors, bounds, info, pre_per_level,
+                    t.RPN_POST_NMS_TOP_N, t.RPN_NMS_THRESH, t.RPN_MIN_SIZE,
+                )
+            )(
+                jax.lax.stop_gradient(fg_scores),
+                jax.lax.stop_gradient(rpn_deltas),
+                im_info,
             )
-        )(
-            jax.lax.stop_gradient(fg_scores),
-            jax.lax.stop_gradient(rpn_deltas),
-            im_info,
-        )
 
         samples = jax.vmap(
             lambda r, rv, gtb, gtv, k: sample_rois(r, rv, gtb, gtv, k, cfg)
@@ -449,6 +465,43 @@ class FPNFasterRCNN(nn.Module):
         per_roi = bce.mean(axis=(-1, -2))                         # (B, R)
         loss = (per_roi * fg).sum() / jnp.maximum(fg.sum(), 1.0)
         return loss, {"MaskBCELoss": loss}
+
+    def mask_iou_probe(self, images, im_info, gt_boxes, gt_valid, gt_masks):
+        """Decoupled mask-quality metric (VERDICT r4 #2): predict masks
+        AT the gt boxes with the gt classes — no RPN, no detection
+        scoring, no NMS confound — and return per-instance IoU of the
+        thresholded 28×28 prediction against the gt polygon bitmap
+        resampled onto the same grid.
+
+        → (iou (B, G) f32, gt_valid (B, G) bool).  A rectangle-biased
+        head scores ≈ box-occupancy here (ellipse ≈ 0.785, triangle
+        ≈ 0.5), so mean IoU ≥ 0.8 on the synthetic ellipse/triangle set
+        is evidence of actual shape learning.
+        """
+        from mx_rcnn_tpu.models.layers import normalize_images
+        from mx_rcnn_tpu.ops.mask_targets import crop_resize_masks
+
+        cfg = self.cfg
+        size = cfg.TRAIN.MASK_SIZE
+        images = normalize_images(images, im_info, cfg)
+        pyramid = self._pyramid(images)
+        boxes = gt_boxes[..., :4]                                 # (B, G, 4)
+        logits = self._mask_forward(pyramid, boxes)               # (B, G, S, S, K)
+        cls = jnp.clip(gt_boxes[..., 4].astype(jnp.int32), 0)
+        pred = one_hot_select(logits, cls[..., None, None]) > 0.0  # (B, G, S, S)
+
+        # gt bitmap in the same box frame: roi == gt box, so this is a
+        # pure M→S bilinear resize of the box-frame bitmap
+        target = jax.vmap(
+            lambda rois_i, gtb, gtm: crop_resize_masks(
+                rois_i, gtb, gtm, size
+            )
+        )(boxes, boxes, gt_masks) >= 0.5                          # (B, G, S, S)
+
+        inter = (pred & target).sum(axis=(-1, -2)).astype(jnp.float32)
+        union = (pred | target).sum(axis=(-1, -2)).astype(jnp.float32)
+        iou = inter / jnp.maximum(union, 1.0)
+        return iou, gt_valid
 
 
 def optax_sigmoid_bce(logits, labels):

@@ -97,7 +97,7 @@ def _fwd_kernel(rois_ref, feat_ref, out_ref, *, pooled, s, scale, rblk):
     exactly cancel the utilization win."""
     b, rb = pl.program_id(0), pl.program_id(2)
     hf, wf = feat_ref.shape[1], feat_ref.shape[2]
-    ph, pw = pooled
+    _, pw = pooled  # only PW shapes the stacked contraction below
     mys, mxs = [], []
     for k in range(rblk):
         my, mx = _matrices_for_roi(
@@ -222,7 +222,9 @@ _VMEM_BUDGET = 8 * 2**20
 _RBLK = 8  # rois per grid step; M/K tiles go 14 → 112 of the MXU's 128
 
 
-def _resident_bytes(h: int, w: int, blk: int, esize: int) -> int:
+def _resident_bytes(
+    h: int, w: int, blk: int, esize: int, pooled_max: int = 14
+) -> int:
     """Worst-case VMEM bytes the blocked kernels hold per step: the
     resident (H, W, blk) slab (feat dtype) or f32 accumulator PLUS the
     f32 stacked roi-block intermediate — fwd's cols (RB·PW, H, blk) or
@@ -243,22 +245,29 @@ def _resident_bytes(h: int, w: int, blk: int, esize: int) -> int:
     The stacked intermediate is ALWAYS f32: tpu.matmul requires a
     32-bit accumulator, so even bf16 graphs materialize fwd cols /
     bwd t_blk in f32 before any cast."""
-    pooled_stack = _RBLK * 14  # PH/PW ≤ 14 in every config
+    pooled_stack = _RBLK * pooled_max
     return (h * w * esize + pooled_stack * h * 4) * blk
 
 
-def fits_vmem(h: int, w: int, c: int) -> bool:
+def fits_vmem(h: int, w: int, c: int, pooled_max: int = 14) -> bool:
     """True iff some channel block keeps the blocked kernels' per-step
     working set (slab + stacked roi-block intermediate) in budget —
     checked for the BACKWARD's f32 accumulator (the larger of the two
-    passes), so a map dispatched resident never OOMs in its grad."""
-    return _resident_bytes(h, w, _cblk(c, largest=128), 4) <= _VMEM_BUDGET
+    passes), so a map dispatched resident never OOMs in its grad.
+    ``pooled_max``: max(PH, PW) of the pooled output — sizes the stacked
+    roi-block intermediate (ADVICE r4: was hardcoded 14)."""
+    return (
+        _resident_bytes(h, w, _cblk(c, largest=128), 4, pooled_max)
+        <= _VMEM_BUDGET
+    )
 
 
-def _cblk_fit(h: int, w: int, c: int, largest: int, esize: int = 4) -> int:
+def _cblk_fit(
+    h: int, w: int, c: int, largest: int, esize: int = 4, pooled_max: int = 14
+) -> int:
     """Largest channel block whose per-step working set fits the budget."""
     blk = _cblk(c, largest)
-    while blk > 128 and _resident_bytes(h, w, blk, esize) > _VMEM_BUDGET:
+    while blk > 128 and _resident_bytes(h, w, blk, esize, pooled_max) > _VMEM_BUDGET:
         blk //= 2
     return blk
 
@@ -282,7 +291,10 @@ def _roi_align_fwd_impl(feat, rois, pooled, scale, s, interpret):
     r = rois.shape[1]
     # 256 cap: the blocked (RB·PW, H, CB) f32 cols intermediate shares
     # VMEM with the resident feature slab
-    cblk = _cblk_fit(hf, wf, c, largest=256, esize=feat.dtype.itemsize)
+    cblk = _cblk_fit(
+        hf, wf, c, largest=256, esize=feat.dtype.itemsize,
+        pooled_max=max(pooled),
+    )
     rois_t, rp = _pad_rois(rois, _RBLK)
     grid = (b, c // cblk, rp // _RBLK)
     kernel = partial(_fwd_kernel, pooled=pooled, s=s, scale=scale, rblk=_RBLK)
@@ -318,7 +330,7 @@ def _roi_align_bwd_impl(feat_shape, feat_dtype, rois, g, pooled, scale, s, inter
     r = rois.shape[1]
     # 256 cap: the f32 accumulator block + the stacked t intermediate
     # must fit the scoped-VMEM budget (512 OOMs at 600x1000/stride-16)
-    cblk = _cblk_fit(hf, wf, c, largest=256, esize=4)
+    cblk = _cblk_fit(hf, wf, c, largest=256, esize=4, pooled_max=max(pooled))
     rois_t, rp = _pad_rois(rois, _RBLK)
     if rp != r:
         g = jnp.pad(g, ((0, 0), (0, rp - r)) + ((0, 0),) * (g.ndim - 2))

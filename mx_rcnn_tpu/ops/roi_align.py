@@ -202,7 +202,10 @@ def extract_roi_features_batched(
     from mx_rcnn_tpu.ops.pallas.roi_align import fits_vmem
 
     if mode == "roi_align" and use_pallas():
-        if fits_vmem(feat.shape[1], feat.shape[2], feat.shape[3]):
+        if fits_vmem(
+            feat.shape[1], feat.shape[2], feat.shape[3],
+            pooled_max=max(pooled),
+        ):
             from mx_rcnn_tpu.ops.pallas.roi_align import roi_align_pallas
 
             return roi_align_pallas(

@@ -100,10 +100,17 @@ def main():
         predictor = Predictor(model, params)
     loader = TestLoader(roidb, cfg, batch_size=args.batch)
 
+    from mx_rcnn_tpu.core.tester import pipelined
+
     def sweep():
+        # 1-deep dispatch pipeline (core.tester.pipelined): device
+        # forward of batch N overlaps host NMS of batch N-1 and the
+        # prefetch thread's assembly of N+1
         n_det = 0
-        for idxs, recs, batch in loader.iter_batched():
-            out = predictor.predict(batch)
+        for (idxs, recs), batch, out in pipelined(
+            predictor,
+            (((idxs, recs), batch) for idxs, recs, batch in loader.iter_batched()),
+        ):
             if "det_valid" in out:
                 n_det += int(np.asarray(out["det_valid"]).sum())
                 continue

@@ -58,11 +58,21 @@ def calibrate_frozen_bn(model, params: Dict, batch: Dict) -> Dict:
     if cfg is not None and getattr(cfg.network, "FOLD_BN", False):
         # the folded graph never materializes the pre-BN conv output
         # (layers.fused_conv_bn computes conv(x, W·mul) + add directly),
-        # so capture on an UNFUSED twin — same param tree by design
+        # so capture on an UNFUSED twin — same param tree by design.
+        # The twin is rebuilt via build_model(cfg), which only matches
+        # end-to-end models; a FOLD_BN stage model (stage_models.*) would
+        # silently get a different class and fail on param-tree mismatch
+        # deep inside apply (ADVICE r4) — refuse it loudly here instead.
         import dataclasses
 
         from mx_rcnn_tpu.models import build_model
 
+        if type(model) is not type(build_model(cfg)):
+            raise TypeError(
+                "calibrate_frozen_bn with FOLD_BN=True only supports "
+                f"build_model(cfg) models, got {type(model).__name__}; "
+                "calibrate the stage model with FOLD_BN off"
+            )
         model = build_model(
             cfg.replace(
                 network=dataclasses.replace(cfg.network, FOLD_BN=False)

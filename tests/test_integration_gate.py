@@ -8,8 +8,12 @@ end to end.
 """
 
 import numpy as np
+import pytest
 
 from mx_rcnn_tpu.tools.integration_gate import run_gate
+
+# up to ~52 min solo on this 1-core box (PARITY round-4 notes)
+pytestmark = [pytest.mark.slow, pytest.mark.deadline(7200)]
 
 
 def test_overfit_reaches_high_map():

@@ -20,6 +20,10 @@ from mx_rcnn_tpu.parallel import (
 )
 from tests.test_model import tiny_batch, tiny_cfg
 
+# each test is a fresh shard_map train-step compile (~100-200 s on this
+# 1-core box); the file totals >580 s
+pytestmark = pytest.mark.slow
+
 
 def test_mesh_shapes():
     mesh = make_mesh()

@@ -14,6 +14,9 @@ import pytest
 from mx_rcnn_tpu.config import generate_config
 from mx_rcnn_tpu.core.checkpoint import latest_checkpoint
 
+# compiles the full DP train step in-process (minutes cold)
+pytestmark = pytest.mark.slow
+
 
 def _tiny_generate_config(network, dataset):
     cfg = generate_config(network, dataset)
