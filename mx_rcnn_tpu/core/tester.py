@@ -64,30 +64,50 @@ class Predictor:
 
     def predict_async(self, batch: Dict[str, np.ndarray]):
         """Dispatch the forward and return the ON-DEVICE outputs without
-        materializing them — jax's async dispatch returns as soon as the
-        computation is enqueued, so the caller can overlap the device
-        forward of batch N with host postprocess of batch N-1
-        (``jax.device_get`` forces when the results are needed).  This
-        is the device half of eval double-buffering; the host half is
-        the TestLoader prefetch thread (VERDICT r4 #8)."""
+        materializing them (``jax.device_get`` forces).  NOTE: on the
+        relay-attached TPU this buys nothing for eval overlap — the
+        relay does not overlap stages of successive one-thread
+        dispatches (measured in ``pipelined``'s docstring) — so eval
+        overlap uses threads calling blocking :meth:`predict` instead.
+        Kept for callers that want dispatch/force split points."""
         return self._fn(self.params, batch)
 
 
-def pipelined(predictor: Predictor, batches):
-    """1-deep dispatch pipeline shared by pred_eval / generate_proposals
-    / bench_eval: for each ``(payload, batch)`` in ``batches``, dispatch
-    batch N to the device, then materialize and yield
-    ``(payload, batch, outputs)`` for batch N-1 — the device forward
-    overlaps host postprocess plus the loader prefetch thread's assembly
-    of N+1."""
-    pending = None
-    for payload, batch in batches:
-        out = predictor.predict_async(batch)
-        if pending is not None:
-            yield pending[0], pending[1], jax.device_get(pending[2])
-        pending = (payload, batch, out)
-    if pending is not None:
-        yield pending[0], pending[1], jax.device_get(pending[2])
+def pipelined(predictor: Predictor, batches, in_flight: int = 2):
+    """Overlapped eval pipeline shared by pred_eval / generate_proposals
+    / bench_eval: keeps ``in_flight`` predict calls running in a small
+    thread pool and yields ``(payload, batch, outputs)`` in input order.
+
+    Why threads and not plain async dispatch: on a relay-attached TPU
+    the per-batch serial chain is upload → compute → fetch (measured
+    b8 flagship: 135 + 72 + ~130 ms), and the relay does NOT overlap
+    stages of successive one-thread dispatches (depth-2 async dispatch
+    measured 0% faster).  Two concurrent requests from separate threads
+    DO overlap (the GIL drops during relay I/O): measured 424 →
+    279 ms/batch device-side (3 threads: 266).  Results are consumed in
+    submission order, so downstream accumulation is order-identical to
+    the serial loop (``tests/test_postprocess.py`` equivalence).
+    """
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+
+    ex = ThreadPoolExecutor(max_workers=max(in_flight, 1))
+    q: deque = deque()
+    try:
+        for payload, batch in batches:
+            q.append((payload, batch, ex.submit(predictor.predict, batch)))
+            while len(q) > max(in_flight, 1):
+                p, b, f = q.popleft()
+                yield p, b, f.result()
+        while q:
+            p, b, f = q.popleft()
+            yield p, b, f.result()
+    finally:
+        # wait=True: on early abandonment (consumer raised/broke out),
+        # drain the in-flight predicts (~one batch chain) rather than
+        # leaving orphan threads driving the relay under whatever the
+        # caller does next; queued-but-unstarted work is cancelled
+        ex.shutdown(wait=True, cancel_futures=True)
 
 
 def im_detect(

@@ -56,6 +56,8 @@ def main():
     ap.add_argument("--compute_dtype", default="bfloat16")
     ap.add_argument("--host_path", action="store_true",
                     help="reference-style f32 upload + host NMS loop")
+    ap.add_argument("--in_flight", type=int, default=2,
+                    help="concurrent predict calls in the relay pipeline")
     args = ap.parse_args()
 
     cfg = generate_config(args.network, "PascalVOC")
@@ -103,13 +105,14 @@ def main():
     from mx_rcnn_tpu.core.tester import pipelined
 
     def sweep():
-        # 1-deep dispatch pipeline (core.tester.pipelined): device
-        # forward of batch N overlaps host NMS of batch N-1 and the
-        # prefetch thread's assembly of N+1
+        # threaded relay pipeline (core.tester.pipelined): --in_flight
+        # concurrent predict calls overlap upload/compute/fetch across
+        # batches, plus the prefetch thread's next-batch assembly
         n_det = 0
         for (idxs, recs), batch, out in pipelined(
             predictor,
             (((idxs, recs), batch) for idxs, recs, batch in loader.iter_batched()),
+            in_flight=args.in_flight,
         ):
             if "det_valid" in out:
                 n_det += int(np.asarray(out["det_valid"]).sum())
