@@ -32,8 +32,11 @@ from mx_rcnn_tpu.data.image import load_image, pick_bucket, prepare_image
 # synthetic render cache bound: first-come records keep their render
 # (~7 MB each at flagship size); past the cap, records re-render per
 # access — no OOM cliff on huge synthetic roidbs, full speed for the
-# small gate/bench sets that revisit the same images every epoch/sweep
-_RENDER_CACHE_MAX = int(os.environ.get("MX_RCNN_RENDER_CACHE", "256"))
+# gate/bench sets that revisit the same images every epoch/sweep.  The
+# counter is a soft cap (unsynchronized prefetch threads may overshoot
+# by a few entries) and is never reclaimed — a >1024-record train roidb
+# can starve later sweeps back to re-rendering, which is slow but safe.
+_RENDER_CACHE_MAX = int(os.environ.get("MX_RCNN_RENDER_CACHE", "1024"))
 _RENDER_CACHE_COUNT = 0
 
 
@@ -52,13 +55,20 @@ def _load_record_image(rec: Dict) -> np.ndarray:
         # bottleneck once the relay pipeline overlapped (7.2 MB/image,
         # disk-backed datasets get the same effect from the OS page
         # cache).  Read-only downstream: prepare_image copies.
-        im = rec.get("_render")
-        if im is None:
-            im = synthetic_image(rec, rec["synthetic_seed"])
-            global _RENDER_CACHE_COUNT
-            if _RENDER_CACHE_COUNT < _RENDER_CACHE_MAX:
-                rec["_render"] = im
-                _RENDER_CACHE_COUNT += 1
+        # The entry is SELF-VALIDATING, keyed by (uri, flipped, seed):
+        # record dicts get shallow-copied (append_flipped_images,
+        # attach_proposals), so a flipped twin inherits the unflipped
+        # record's "_render" — serving it blind would be exactly the
+        # pixels-vs-gt mismatch the comment above warns about.
+        key = (rec["image"], bool(rec.get("flipped")), rec["synthetic_seed"])
+        cached = rec.get("_render")
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        im = synthetic_image(rec, rec["synthetic_seed"])
+        global _RENDER_CACHE_COUNT
+        if _RENDER_CACHE_COUNT < _RENDER_CACHE_MAX:
+            rec["_render"] = (key, im)
+            _RENDER_CACHE_COUNT += 1
         return im
     im = load_image(rec["image"])
     if rec.get("flipped"):

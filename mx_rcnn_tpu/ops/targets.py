@@ -192,7 +192,10 @@ def sample_rois(
     fg_sel = _random_keep_k(k_fg, fg_cand, num_fg)
     bg_sel = _random_keep_k(k_bg, bg_cand, r_out - fg_sel.sum())
 
-    # pack: fg first, then bg, then ignore padding — fixed R_out rows
+    # pack: fg first, then bg, then ignore padding — fixed R_out rows.
+    # LOAD-BEARING ordering: the Mask R-CNN branch (models/fpn.py::
+    # _mask_loss) runs on only the first FG_FRACTION·BATCH_ROIS slots,
+    # relying on every fg roi landing in that prefix
     sel_priority = jnp.where(fg_sel, 2.0 * _BIG, 0.0) + jnp.where(bg_sel, _BIG, 0.0)
     sel_priority = sel_priority + jax.random.uniform(k_tie, (p,))
     if p < r_out:  # static: fewer candidates than the roi budget (tiny tests)

@@ -291,6 +291,38 @@ class TestMaskIoUProbe:
         np.testing.assert_array_equal(np.asarray(valid), batch["gt_valid"])
 
 
+class TestMaskFgSlice:
+    def test_fg_rois_all_in_prefix(self):
+        """The invariant the mask branch's fg-prefix slice rests on:
+        sample_rois packs every fg roi into the first
+        FG_FRACTION·BATCH_ROIS slots."""
+        import jax as _jax
+
+        from mx_rcnn_tpu.ops.targets import sample_rois
+
+        cfg = fpn_cfg()
+        rng = np.random.RandomState(0)
+        p = 64
+        rois = np.zeros((p, 4), np.float32)
+        rois[:, 0] = rng.uniform(0, 80, p)
+        rois[:, 1] = rng.uniform(0, 80, p)
+        rois[:, 2] = rois[:, 0] + rng.uniform(10, 47, p)
+        rois[:, 3] = rois[:, 1] + rng.uniform(10, 47, p)
+        gtb = np.asarray([[10, 10, 70, 70, 1], [50, 60, 120, 110, 2],
+                          [0, 0, 0, 0, 0], [0, 0, 0, 0, 0]], np.float32)
+        gtv = np.asarray([True, True, False, False])
+        nfg = int(round(cfg.TRAIN.FG_FRACTION * cfg.TRAIN.BATCH_ROIS))
+        for seed in range(5):
+            s = sample_rois(
+                jnp.asarray(rois), jnp.ones((p,), bool), jnp.asarray(gtb),
+                jnp.asarray(gtv), _jax.random.key(seed), cfg,
+            )
+            labels = np.asarray(s.labels)
+            assert (labels[nfg:] <= 0).all(), (
+                f"fg roi escaped the first {nfg} slots at seed {seed}"
+            )
+
+
 class TestMaskInference:
     def test_pred_eval_threads_masks_to_imdb(self, tmp_path):
         """Full inference loop with the mask model: im_detect exposes
