@@ -37,7 +37,9 @@ _METRIC_NAMES = {
 _ALL_FAMILIES = ("resnet", "resnet_fpn", "mask_resnet_fpn", "vgg")
 
 
-def bench_one(network: str, batch_images: int, iters: int) -> dict:
+def bench_one(
+    network: str, batch_images: int, iters: int, steps_per_call: int = 1
+) -> dict:
     """Train-throughput measurement for one family; → the JSON record."""
     import jax
 
@@ -85,23 +87,42 @@ def bench_one(network: str, batch_images: int, iters: int) -> dict:
     )["params"]
     tx = make_optimizer(cfg, lambda s: cfg.TRAIN.LEARNING_RATE)
     state = create_train_state(params, tx)
-    step = make_train_step(model, tx, donate=True)
+    # steps_per_call > 1: the device-side training loop (lax.scan of K
+    # full optimizer steps per dispatch) — a single host dispatch carries
+    # ~17 ms of relay/tunnel latency (scripts/probe_opt.py), which K
+    # amortizes; exact-equivalence pinned by
+    # test_model.py::test_multi_step_matches_sequential_steps
+    step = make_train_step(model, tx, donate=True,
+                           steps_per_call=steps_per_call)
+    if steps_per_call > 1:
+        # device-resident stack (jnp): a numpy stack here would re-cross
+        # the host->device tunnel (~300 MB) on EVERY dispatch
+        import jax.numpy as jnp
+
+        batch = {
+            k: jnp.broadcast_to(v[None], (steps_per_call,) + v.shape)
+            for k, v in batch.items()
+        }
+
+    def last_loss(aux):
+        l = np.asarray(aux["loss"])
+        return float(l[-1]) if l.ndim else float(l)
 
     rng = jax.random.key(0)
     # warmup / compile (value fetch = the only trustworthy sync on the
     # axon relay; block_until_ready returns early there)
     state, aux = step(state, batch, rng)
-    float(aux["loss"])
+    last_loss(aux)
 
     t0 = time.perf_counter()
     for _ in range(iters):
         state, aux = step(state, batch, rng)
     # the final loss depends on every chained step, so this fetch forces
     # the whole sequence; one ~85ms tunnel roundtrip amortized over iters
-    assert np.isfinite(float(aux["loss"]))
+    assert np.isfinite(last_loss(aux))
     dt = time.perf_counter() - t0
 
-    imgs_per_sec = b * iters / dt
+    imgs_per_sec = b * iters * steps_per_call / dt
     return {
         "metric": f"train_imgs_per_sec_per_chip_{_METRIC_NAMES[network]}",
         "value": round(imgs_per_sec, 3),
@@ -119,6 +140,10 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument(
+        "--steps_per_call", type=int, default=1,
+        help="K train steps per dispatch (device-side lax.scan loop)",
+    )
+    ap.add_argument(
         "--all", action="store_true",
         help="bench every family; one JSON line each",
     )
@@ -135,7 +160,7 @@ def main():
     families = _ALL_FAMILIES if args.all else (args.network,)
     records = []
     for network in families:
-        rec = bench_one(network, args.batch, args.iters)
+        rec = bench_one(network, args.batch, args.iters, args.steps_per_call)
         records.append(rec)
         print(json.dumps(rec), flush=True)
     if args.out:

@@ -1,0 +1,168 @@
+"""Round-5 probe: why does the SGD update cost ~15 ms (13% of the step)?
+
+Methodology: probe_backbone2's in-jit chaining — N updates under ONE
+lax.fori_loop dispatch, report (t(N) - t(1)) / (N - 1), so relay
+dispatch latency cancels exactly.
+
+The flagship tree has 530 leaves (103 trainable after the FIXED_PARAMS
+mask, 47.1M params).  Roofline: the update reads g/p/m and writes p/m
+≈ 5 x 188 MB ≈ 1.2 ms at v5e HBM bandwidth.  Candidates:
+
+  chain    the production make_optimizer path (baseline)
+  fused    handwritten one-tree_map SGD, same math
+  flat     ravel-based: momentum + update math on ONE concatenated f32
+           vector, sliced back out per leaf
+
+Usage: PYTHONPATH=/root/.axon_site:/root/repo python scripts/probe_opt.py
+"""
+import dataclasses
+import time
+
+import flax
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from mx_rcnn_tpu.utils.platform import enable_compile_cache
+
+enable_compile_cache()
+
+from __graft_entry__ import _batch, _flagship_cfg  # noqa: E402
+from mx_rcnn_tpu.core.train import (  # noqa: E402
+    create_train_state,
+    is_frozen_path,
+    make_optimizer,
+)
+from mx_rcnn_tpu.models import build_model  # noqa: E402
+
+N = 9
+
+
+def timeit(fn, *args, iters=6, warmup=2):
+    for _ in range(warmup):
+        r = fn(*args)
+    _ = float(np.asarray(jax.tree_util.tree_leaves(r)[0]).ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = fn(*args)
+    _ = float(np.asarray(jax.tree_util.tree_leaves(r)[0]).ravel()[0])
+    return (time.perf_counter() - t0) / iters * 1000
+
+
+def bench_chained(tag, one_step, carry0):
+    """one_step: carry -> carry.  Chains n applications inside one jit."""
+
+    def runner(n):
+        @jax.jit
+        def run(carry):
+            return lax.fori_loop(0, n, lambda i, c: one_step(c), carry)
+
+        return run
+
+    t1 = timeit(runner(1), carry0)
+    tn = timeit(runner(N), carry0)
+    per = (tn - t1) / (N - 1)
+    print(f"{tag:<32s} {per:8.2f} ms  (t1={t1:.1f} tN={tn:.1f})", flush=True)
+    return per
+
+
+def main():
+    cfg = _flagship_cfg()
+    cfg = cfg.replace(
+        network=dataclasses.replace(
+            cfg.network, COMPUTE_DTYPE="bfloat16", FOLD_BN=True
+        ),
+        TRAIN=dataclasses.replace(cfg.TRAIN, BATCH_IMAGES=8),
+    )
+    model = build_model(cfg)
+    h, w = cfg.SHAPE_BUCKETS[0]
+    batch = _batch(cfg, 8, h, w)
+    params = model.init(
+        {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+        train=True,
+        **batch,
+    )["params"]
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    print(f"tree: {n_leaves} leaves, {n_params/1e6:.1f}M params", flush=True)
+
+    t = cfg.TRAIN
+    g0 = jax.tree_util.tree_map(lambda p: jnp.full_like(p, 1e-6), params)
+
+    # --- 1. production chain
+    tx = make_optimizer(cfg, lambda s: t.LEARNING_RATE)
+    st0 = create_train_state(params, tx)
+
+    def step_chain(st):
+        updates, opt_state = tx.update(g0, st.opt_state, st.params)
+        return st._replace(
+            step=st.step + 1,
+            params=optax.apply_updates(st.params, updates),
+            opt_state=opt_state,
+        )
+
+    bench_chained("chain (production optax)", step_chain, st0)
+
+    # --- shared freeze mask
+    flat = flax.traverse_util.flatten_dict(params)
+    fixed = cfg.network.FIXED_PARAMS
+    gf = flax.traverse_util.flatten_dict(g0)
+    train_keys = sorted(k for k in flat if not is_frozen_path(k, fixed))
+    print(f"trainable: {len(train_keys)} leaves, "
+          f"{sum(flat[k].size for k in train_keys)/1e6:.1f}M", flush=True)
+
+    # --- 2. handwritten fused tree_map (one kernel per trainable leaf)
+    mom0 = {k: jnp.zeros_like(flat[k]) for k in train_keys}
+
+    def step_fused(carry):
+        p, m = carry
+        new_p, new_m = dict(p), dict(m)
+        for k in train_keys:
+            gk = jnp.clip(gf[k], -t.CLIP_GRADIENT, t.CLIP_GRADIENT)
+            gk = gk + t.WD * p[k]
+            mk2 = t.MOMENTUM * m[k] + gk
+            new_m[k] = mk2
+            new_p[k] = p[k] - t.LEARNING_RATE * mk2
+        return new_p, new_m
+
+    bench_chained("fused tree_map SGD", step_fused, (dict(flat), mom0))
+
+    # --- 3. flat ravel-based
+    sizes = [int(flat[k].size) for k in train_keys]
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    flat_p0 = jnp.concatenate([flat[k].ravel() for k in train_keys])
+    flat_m0 = jnp.zeros_like(flat_p0)
+    fg_const = jnp.concatenate([gf[k].ravel() for k in train_keys])
+
+    def step_flat(carry):
+        fp, fm = carry
+        g = jnp.clip(fg_const, -t.CLIP_GRADIENT, t.CLIP_GRADIENT) + t.WD * fp
+        fm2 = t.MOMENTUM * fm + g
+        return fp - t.LEARNING_RATE * fm2, fm2
+
+    bench_chained("flat SGD (pre-raveled grads)", step_flat,
+                  (flat_p0, flat_m0))
+
+    # flat including ravel of the incoming grad tree + slice-back for the
+    # model tree — the full cost a flat optimizer would add to the step
+    def step_flat_full(carry):
+        fp, fm = carry
+        fg = jnp.concatenate([gf[k].ravel() for k in train_keys])
+        g = jnp.clip(fg, -t.CLIP_GRADIENT, t.CLIP_GRADIENT) + t.WD * fp
+        fm2 = t.MOMENTUM * fm + g
+        fp2 = fp - t.LEARNING_RATE * fm2
+        # slice every leaf back out and fold a value in so nothing DCEs
+        acc = jnp.float32(0)
+        for i, k in enumerate(train_keys):
+            leaf = lax.dynamic_slice(fp2, (int(offsets[i]),), (sizes[i],))
+            acc = acc + leaf[0].astype(jnp.float32)
+        return fp2 + 0 * acc.astype(fp2.dtype), fm2
+
+    bench_chained("flat SGD + ravel + slice-back", step_flat_full,
+                  (flat_p0, flat_m0))
+
+
+if __name__ == "__main__":
+    main()

@@ -294,6 +294,58 @@ def test_grad_accum_matches_plain_step():
         )
 
 
+def test_multi_step_matches_sequential_steps():
+    """steps_per_call=2 (device-side lax.scan training loop) must equal
+    two sequential single-step calls exactly: same rng-fold-by-step
+    trajectory, same final params, and the aux stack carries both steps'
+    metrics."""
+    import jax
+
+    from mx_rcnn_tpu.core.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+        stack_batches,
+    )
+    from mx_rcnn_tpu.models import FasterRCNN
+
+    cfg = tiny_cfg()
+    model = FasterRCNN(cfg)
+    rng = np.random.RandomState(7)
+    b1 = tiny_batch(rng, b=1, h=96, w=96)
+    b2 = tiny_batch(rng, b=1, h=96, w=96)
+    params = model.init(
+        {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+        b1["images"], b1["im_info"], b1["gt_boxes"], b1["gt_valid"],
+        train=True,
+    )["params"]
+    tx = make_optimizer(cfg, lambda s: 0.01)
+    key = jax.random.key(9)
+
+    single = make_train_step(model, tx, donate=False)
+    st = create_train_state(params, tx)
+    st, aux1 = single(st, b1, key)
+    st, aux2 = single(st, b2, key)
+
+    multi = make_train_step(model, tx, donate=False, steps_per_call=2)
+    mst, aux_stack = multi(
+        create_train_state(params, tx), stack_batches([b1, b2]), key
+    )
+
+    assert int(jax.device_get(mst.step)) == 2
+    losses = np.asarray(jax.device_get(aux_stack["loss"]))
+    assert losses.shape == (2,)
+    np.testing.assert_allclose(losses[0], float(aux1["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(losses[1], float(aux2["loss"]), rtol=1e-5)
+    s_flat = jax.tree_util.tree_flatten_with_path(jax.device_get(st.params))[0]
+    m_flat = jax.tree_util.tree_flatten_with_path(jax.device_get(mst.params))[0]
+    for (path, sv), (_, mv) in zip(s_flat, m_flat):
+        np.testing.assert_allclose(
+            np.asarray(mv), np.asarray(sv), rtol=1e-5, atol=1e-6,
+            err_msg=f"param mismatch at {jax.tree_util.keystr(path)}",
+        )
+
+
 def test_fold_bn_exact_rewrite():
     """FOLD_BN folds the frozen-BN affine into the conv kernel: same
     param tree, same forward, same grads (incl. BN affine grads) —
