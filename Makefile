@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast bench integration-gate clean-native
+.PHONY: native test test-kernels test-fast resilience bench integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -40,6 +40,13 @@ test-fast:
 	$(PY) -m pytest tests/ -m "not slow" -q
 	$(PY) -m pytest "tests/test_parallel.py::test_mesh_shapes" \
 	      "tests/test_parallel.py::test_dp_grads_match_single_device" -q
+
+# fault-injection resilience suite (ISSUE 1): guarded-loop rollback,
+# crash-safe checkpoint fallback, loader failure budget, step watchdog —
+# all driven deterministically via MX_RCNN_FAULTS, CPU-only, <1 min
+resilience:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py \
+	      tests/test_preemption.py -q
 
 # flagship train throughput (real TPU); prints one JSON line
 bench:

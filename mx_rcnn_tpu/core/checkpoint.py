@@ -14,18 +14,41 @@ checkpoint (``step_EEEE_SSSSSS``) that resume continues from exactly —
 the loader's deterministic epoch plan makes skip-to-batch sound, so a
 preempted-and-resumed run consumes the identical data stream as an
 uninterrupted one.
+
+Crash safety: a save writes into ``<name>.tmp``, records a
+``manifest.json`` (step/epoch, per-file sizes, param-tree checksum)
+inside it, then atomically renames to ``<name>`` — a process killed
+mid-save leaves only an orphaned ``.tmp`` that no reader ever selects.
+``latest_checkpoint``/``restorable_checkpoints`` verify the manifest
+(presence + file sizes) and fall back past corrupt, truncated, or
+uncommitted dumps to the newest verifiable one; ``load_checkpoint``
+additionally re-checksums the restored tree.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
 import signal
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from mx_rcnn_tpu.core.train import TrainState
+from mx_rcnn_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
+
+MANIFEST = "manifest.json"
+MANIFEST_FORMAT = 1
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A restored tree's checksum disagrees with its manifest."""
 
 
 def _ckpt_name(epoch: int, batch_in_epoch: int) -> str:
@@ -51,73 +74,215 @@ def _parse_ckpt_name(name: str) -> Optional[Tuple[int, int]]:
     return None
 
 
+def tree_checksum(tree: Any) -> str:
+    """Deterministic sha256 over a pytree's structure + leaf bytes.
+
+    Path strings and dtype/shape are hashed alongside the raw bytes so a
+    silently reshaped or re-typed leaf can't collide with the original.
+    """
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in leaves:
+        arr = np.asarray(jax.device_get(leaf))
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(f"{arr.dtype}{arr.shape}".encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _manifest_files(root: str) -> Dict[str, int]:
+    """relpath → size for every regular file under ``root`` (excluding
+    the manifest itself)."""
+    out: Dict[str, int] = {}
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            full = os.path.join(dirpath, f)
+            rel = os.path.relpath(full, root)
+            if rel == MANIFEST:
+                continue
+            out[rel] = os.path.getsize(full)
+    return out
+
+
 def save_checkpoint(
     prefix: str, state: TrainState, epoch: int, batch_in_epoch: int = 0
 ) -> str:
-    path = os.path.abspath(
+    """Crash-safe save: write ``<name>.tmp``, fsync a manifest into it,
+    atomically rename to ``<name>``.  A kill at ANY point leaves either
+    the previous committed dump intact or an orphaned ``.tmp`` that
+    every reader skips (and ``prune_step_checkpoints`` removes)."""
+    import shutil
+
+    final = os.path.abspath(
         os.path.join(prefix, _ckpt_name(epoch, batch_in_epoch))
     )
+    tmp = final + ".tmp"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    host_state = jax.device_get(state)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, jax.device_get(state), force=True)
+    ckptr.save(tmp, host_state, force=True)
     ckptr.wait_until_finished()
-    return path
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "epoch": epoch,
+        "batch_in_epoch": batch_in_epoch,
+        "step": int(np.asarray(host_state.step)) if hasattr(host_state, "step") else None,
+        "checksum": tree_checksum(host_state),
+        "files": _manifest_files(tmp),
+    }
+    # injection point: a SIGKILL between the data write and the commit
+    faults.crash_save()
+    mpath = os.path.join(tmp, MANIFEST)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    if os.path.isdir(final):
+        shutil.rmtree(final)
+    os.rename(tmp, final)
+    # durability of the rename itself
+    try:
+        dfd = os.open(os.path.dirname(final), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:  # pragma: no cover — exotic filesystems
+        pass
+    return final
+
+
+def read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(path, MANIFEST)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def is_committed(path: str) -> bool:
+    """Cheap integrity check: the manifest exists and every file it
+    recorded is present with the recorded size — catches uncommitted
+    (killed mid-save), truncated, and partially deleted dumps without
+    the cost of a restore."""
+    man = read_manifest(path)
+    if man is None or not isinstance(man.get("files"), dict):
+        return False
+    for rel, size in man["files"].items():
+        full = os.path.join(path, rel)
+        try:
+            if os.path.getsize(full) != int(size):
+                return False
+        except OSError:
+            return False
+    return True
 
 
 def load_checkpoint(
-    prefix: str, epoch: int, target: TrainState, batch_in_epoch: int = 0
+    prefix: str,
+    epoch: int,
+    target: TrainState,
+    batch_in_epoch: int = 0,
+    verify: bool = True,
 ) -> TrainState:
     path = os.path.abspath(
         os.path.join(prefix, _ckpt_name(epoch, batch_in_epoch))
     )
     ckptr = ocp.StandardCheckpointer()
-    return ckptr.restore(path, target=jax.device_get(target))
+    restored = ckptr.restore(path, target=jax.device_get(target))
+    if verify:
+        man = read_manifest(path)
+        if man is not None and man.get("checksum"):
+            got = tree_checksum(restored)
+            if got != man["checksum"]:
+                raise CheckpointCorrupt(
+                    f"{path}: restored tree checksum {got[:12]}… does not "
+                    f"match manifest {str(man['checksum'])[:12]}…"
+                )
+    return restored
 
 
 def latest_epoch(prefix: str) -> Optional[int]:
-    if not os.path.isdir(prefix):
-        return None
-    epochs = [
-        int(d.split("_")[1])
-        for d in os.listdir(prefix)
-        if d.startswith("epoch_") and d.split("_")[1].isdigit()
-    ]
+    epochs = [e for e, b in restorable_checkpoints(prefix) if b == 0]
     return max(epochs) if epochs else None
 
 
-def latest_checkpoint(prefix: str) -> Optional[Tuple[int, int]]:
-    """(epoch, batch_in_epoch) of the newest checkpoint, epoch- or
-    mid-epoch; batch 0 means an epoch boundary.  A ``step_E_B`` dump is
-    newer than ``epoch_E`` (it was taken inside epoch E after the
-    boundary save of epoch E) but older than ``epoch_{E+1}``."""
+def restorable_checkpoints(prefix: str) -> List[Tuple[int, int]]:
+    """All verifiable checkpoints, newest first.  Uncommitted ``.tmp``
+    dirs never parse as checkpoint names; committed-looking dirs whose
+    manifest is missing or whose files are truncated are skipped — the
+    fallback-past-corruption guarantee."""
     if not os.path.isdir(prefix):
-        return None
-    found = [
-        parsed for d in os.listdir(prefix)
-        if (parsed := _parse_ckpt_name(d)) is not None
-    ]
-    if not found:
-        return None
+        return []
+    found = []
+    for d in os.listdir(prefix):
+        parsed = _parse_ckpt_name(d)
+        if parsed is None:
+            continue
+        if not is_committed(os.path.join(prefix, d)):
+            logger.warning(
+                "skipping unverifiable checkpoint %s (missing/corrupt "
+                "manifest or truncated files)", os.path.join(prefix, d)
+            )
+            continue
+        found.append(parsed)
     # (epoch, batch) lexicographic is exactly the resume order because a
     # step dump inside epoch E carries epoch index E while the boundary
     # save at the END of epoch E is named epoch_{E+1}
-    return max(found)
+    return sorted(found, reverse=True)
+
+
+def latest_checkpoint(prefix: str) -> Optional[Tuple[int, int]]:
+    """(epoch, batch_in_epoch) of the newest VERIFIABLE checkpoint,
+    epoch- or mid-epoch; batch 0 means an epoch boundary.  A ``step_E_B``
+    dump is newer than ``epoch_E`` (it was taken inside epoch E after the
+    boundary save of epoch E) but older than ``epoch_{E+1}``.  Corrupt or
+    uncommitted dumps are skipped in favor of the newest good one."""
+    found = restorable_checkpoints(prefix)
+    return found[0] if found else None
+
+
+def load_restorable(
+    prefix: str, target: TrainState
+) -> Optional[Tuple[Tuple[int, int], TrainState]]:
+    """Restore the newest checkpoint that actually loads and verifies,
+    falling back past corrupt dumps (manifest-valid but checksum-bad, or
+    unreadable) to older ones.  Returns ``((epoch, batch), state)`` or
+    None when nothing is restorable."""
+    for epoch, batch in restorable_checkpoints(prefix):
+        try:
+            state = load_checkpoint(prefix, epoch, target, batch)
+            return (epoch, batch), state
+        except Exception as e:  # noqa: BLE001 — fall back to the previous dump
+            logger.warning(
+                "checkpoint (epoch %d, batch %d) failed to restore (%r) — "
+                "falling back to the previous dump", epoch, batch, e
+            )
+    return None
 
 
 def prune_step_checkpoints(prefix: str, up_to_epoch: int) -> None:
     """Delete ``step_E_B`` preemption dumps with E ≤ ``up_to_epoch`` —
-    they are superseded once ``epoch_{E+1}`` exists.  Without pruning, a
-    long run on a preemptible pool accumulates one full params+momentum
-    dump per preemption."""
+    they are superseded once ``epoch_{E+1}`` exists — plus ANY orphaned
+    ``.tmp`` dir (an interrupted save that will never be committed).
+    Without pruning, a long run on a preemptible pool accumulates one
+    full params+momentum dump per preemption."""
     import shutil
 
     if not os.path.isdir(prefix):
         return
     for d in os.listdir(prefix):
+        full = os.path.join(prefix, d)
+        if d.endswith(".tmp") and os.path.isdir(full):
+            logger.info("pruning orphaned partial checkpoint %s", full)
+            shutil.rmtree(full, ignore_errors=True)
+            continue
         parsed = _parse_ckpt_name(d)
         if parsed is None or parsed[1] == 0:
             continue
         if parsed[0] <= up_to_epoch:
-            shutil.rmtree(os.path.join(prefix, d), ignore_errors=True)
+            shutil.rmtree(full, ignore_errors=True)
 
 
 class PreemptionGuard:

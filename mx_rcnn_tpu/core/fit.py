@@ -17,6 +17,11 @@ import numpy as np
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.core.metrics import MetricTracker, Speedometer
+from mx_rcnn_tpu.core.resilience import (
+    DivergencePolicy,
+    GuardedLoop,
+    StepWatchdog,
+)
 from mx_rcnn_tpu.core.train import (
     create_train_state,
     make_lr_schedule,
@@ -55,12 +60,22 @@ def fit(
     init_donor: Optional[Dict] = None,
     frequent: int = 20,
     max_steps: int = 0,
+    guard_policy: Optional[DivergencePolicy] = None,
+    step_timeout: float = 0.0,
 ) -> Dict:
     """Train ``model`` on ``roidb`` and return the final params.
 
     ``init_donor``: param tree whose matching subtrees seed the init
     (pretrained backbone / previous stage).  ``fixed_params``: freeze-set
     override (FIXED_PARAMS_SHARED for stage-2).
+
+    Every step runs under a :class:`GuardedLoop` (``guard_policy``
+    overrides the divergence defaults): a NaN/Inf or spiking loss is
+    retried with LR backoff, then rolled back and the poison batch
+    skipped, instead of the pre-resilience behavior of finishing the
+    whole run and *warning* about the destroyed loss at the end.
+    ``step_timeout`` > 0 additionally arms a watchdog that aborts a hung
+    step with :data:`~mx_rcnn_tpu.core.resilience.WATCHDOG_EXIT_CODE`.
     """
     loader = TrainLoader(
         roidb, cfg, cfg.TRAIN.BATCH_IMAGES,
@@ -95,19 +110,27 @@ def fit(
 
     tracker = MetricTracker()
     speedo = Speedometer(cfg.TRAIN.BATCH_IMAGES, frequent)
+    watchdog = StepWatchdog(step_timeout) if step_timeout > 0 else None
+    guard = GuardedLoop(step_fn, policy=guard_policy, watchdog=watchdog)
     total_steps = 0
     for epoch in range(epochs):
         for batch in loader:
-            state, aux = step_fn(state, batch, rng)
-            tracker.update({k: float(v) for k, v in jax.device_get(aux).items()})
+            state, aux, ok = guard.step(state, batch, rng)
+            if ok:
+                tracker.update({k: float(v) for k, v in aux.items()})
             total_steps += 1
             speedo(epoch, total_steps, tracker)
             if max_steps and total_steps >= max_steps:
                 break
         if max_steps and total_steps >= max_steps:
             break
-    last_loss = float(jax.device_get(aux)["loss"]) if total_steps else float("nan")
+    last_loss = guard.last_loss if total_steps else float("nan")
     logger.info("fit done: %d steps, last loss %.4f", total_steps, last_loss)
+    if guard.skipped_batches:
+        logger.warning(
+            "fit skipped %d poison batch(es) after rollback "
+            "(%d retried steps)", guard.skipped_batches, guard.retried_steps
+        )
     if total_steps and not np.isfinite(last_loss):
         logger.warning("fit finished with non-finite loss")
     return jax.device_get(state.params)

@@ -135,7 +135,21 @@ def make_train_step(
     (no fold_in of state.step): with per-image ``sample_seeds`` every
     image's roi/anchor subsample is then identical every step — the
     zero-label-churn ablation mode (scripts/probe_mask_churn.py).
+
+    The returned step additionally accepts an optional ``lr_scale``
+    keyword (default None = untouched): a scalar multiplied into the
+    final updates, i.e. a one-step effective-LR override.  The guarded
+    loop (core/resilience.py) uses it for exponential LR backoff when
+    retrying a diverged step; momentum accumulation is deliberately NOT
+    rescaled (the retry should damp this step, not rewrite history).
     """
+    if steps_per_call > 1 and pmean_axis is not None:
+        raise ValueError(
+            "steps_per_call > 1 under a pmean_axis is unsupported: "
+            "shard_map callers shard the batch's leading axis, which here "
+            "would silently be the K-steps axis — keep steps_per_call=1 "
+            "under data parallelism until the combo is tested"
+        )
 
     def _grads_and_aux(params, batch, rng):
         def loss_fn(p):
@@ -152,7 +166,12 @@ def make_train_step(
         aux["loss"] = loss
         return grads, aux
 
-    def step_fn(state: TrainState, batch: Dict[str, jnp.ndarray], rng: jax.Array):
+    def step_fn(
+        state: TrainState,
+        batch: Dict[str, jnp.ndarray],
+        rng: jax.Array,
+        lr_scale=None,
+    ):
         if fold_step_rng:
             rng = jax.random.fold_in(rng, state.step)
 
@@ -200,14 +219,19 @@ def make_train_step(
                 {k: v.astype(jnp.float32) for k, v in aux.items()}, pmean_axis
             )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        if lr_scale is not None:
+            s = jnp.asarray(lr_scale, jnp.float32)
+            updates = jax.tree_util.tree_map(
+                lambda u: u * s.astype(u.dtype), updates
+            )
         params = optax.apply_updates(state.params, updates)
         new_state = TrainState(state.step + 1, params, opt_state)
         return new_state, aux
 
     if steps_per_call > 1:
-        def multi_fn(state, batches, rng):
+        def multi_fn(state, batches, rng, lr_scale=None):
             def body(st, mb):
-                return step_fn(st, mb, rng)
+                return step_fn(st, mb, rng, lr_scale)
 
             return jax.lax.scan(body, state, batches)
 

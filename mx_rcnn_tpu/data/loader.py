@@ -19,6 +19,7 @@ A small background-thread prefetcher overlaps cv2 decode with TPU steps
 
 from __future__ import annotations
 
+import logging
 import os
 import queue
 import threading
@@ -27,7 +28,16 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.core.resilience import RetryPolicy
 from mx_rcnn_tpu.data.image import load_image, pick_bucket, prepare_image
+from mx_rcnn_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
+
+
+class LoaderFaultBudgetExceeded(RuntimeError):
+    """More records failed to load than the configured budget — aborting
+    so silent data loss can't masquerade as training."""
 
 # synthetic render cache bound: first-come records keep their render
 # (~7 MB each at flagship size); past the cap, records re-render per
@@ -222,7 +232,18 @@ def _prefetch_iter(source, prefetch: int):
 
 
 class TrainLoader:
-    """AnchorLoader twin: shuffled, aspect-grouped, bucket-padded batches."""
+    """AnchorLoader twin: shuffled, aspect-grouped, bucket-padded batches.
+
+    Fault tolerance: a record whose image fails to load (missing file,
+    corrupt decode, NFS hiccup) no longer kills the prefetch worker — the
+    read is retried per ``retry`` (deterministic, jitter-free), then the
+    record is dropped from the batch plan: its slot is filled by the
+    batch's first good record (shapes must stay fixed for the jit cache)
+    and ``substituted_records``/``record_failures`` count the damage.  A
+    batch with NO loadable record is dropped whole.  More failures than
+    ``failure_budget`` abort the run with
+    :class:`LoaderFaultBudgetExceeded` — bounded, loud data loss.
+    """
 
     def __init__(
         self,
@@ -234,6 +255,8 @@ class TrainLoader:
         prefetch: int = 2,
         proposal_count: int = 0,
         row_slice: Optional[slice] = None,
+        retry: Optional[RetryPolicy] = None,
+        failure_budget: Optional[int] = None,
     ):
         self.roidb = roidb
         self.cfg = cfg
@@ -251,6 +274,43 @@ class TrainLoader:
         # batches already trained this epoch (the plan is deterministic
         # per (seed, epoch), so skipping reproduces the exact stream)
         self.skip_batches = 0
+        self.retry = retry or RetryPolicy(tries=3, delay=0.0)
+        # default budget: 1% of the roidb, floored so tiny smoke runs
+        # aren't aborted by a single flaky read
+        self.failure_budget = (
+            failure_budget if failure_budget is not None
+            else max(32, len(roidb) // 100)
+        )
+        self.record_failures = 0
+        self.substituted_records = 0
+        self.dropped_batches = 0
+
+    def _load_guarded(self, i: int) -> Optional[np.ndarray]:
+        """Load record ``i``'s image with bounded retry; None = the
+        record is skipped (budget permitting)."""
+        rec = self.roidb[i]
+
+        def attempt(_k: int) -> np.ndarray:
+            faults.fail_record(i)  # test injection, no-op in production
+            return _load_record_image(rec)
+
+        try:
+            return self.retry.run(attempt)
+        except Exception as e:  # noqa: BLE001 — any read/decode failure
+            self.record_failures += 1
+            logger.warning(
+                "record %d (%s) failed after %d attempts: %r — dropped "
+                "(%d/%d failure budget)",
+                i, rec.get("image"), self.retry.tries, e,
+                self.record_failures, self.failure_budget,
+            )
+            if self.record_failures > self.failure_budget:
+                raise LoaderFaultBudgetExceeded(
+                    f"{self.record_failures} records failed to load "
+                    f"(budget {self.failure_budget}); latest: record {i} "
+                    f"({rec.get('image')}): {e!r}"
+                ) from e
+            return None
 
     def __len__(self) -> int:
         return len(self.roidb) // self.batch_size
@@ -285,13 +345,36 @@ class TrainLoader:
         if self.row_slice is not None:
             plan = [(b, idxs[self.row_slice]) for b, idxs in plan]
         pc = self.proposal_count
-        source = (
-            make_batch(
-                [self.roidb[i] for i in idxs], self.cfg, bucket,
-                proposal_count=pc, seeds=idxs,
+
+        def build(bucket, idxs):
+            images = [self._load_guarded(i) for i in idxs]
+            good = [(i, im) for i, im in zip(idxs, images) if im is not None]
+            if not good:
+                self.dropped_batches += 1
+                logger.warning(
+                    "dropping whole batch %s — no loadable record", idxs
+                )
+                return None
+            # deterministic skip: a failed slot is filled with the batch's
+            # first good record (record + pixels + seed stay consistent),
+            # keeping the batch shape fixed for the jit cache
+            filled, imgs = [], []
+            for i, im in zip(idxs, images):
+                if im is None:
+                    i, im = good[0]
+                    self.substituted_records += 1
+                filled.append(i)
+                imgs.append(im)
+            return make_batch(
+                [self.roidb[i] for i in filled], self.cfg, bucket,
+                images=imgs, proposal_count=pc, seeds=filled,
                 with_masks=self.cfg.network.USE_MASK,
             )
+
+        source = (
+            batch
             for bucket, idxs in plan
+            if (batch := build(bucket, idxs)) is not None
         )
         yield from _prefetch_iter(source, self.prefetch)
 

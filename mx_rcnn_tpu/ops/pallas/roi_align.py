@@ -34,6 +34,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams; accept either
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 
 def _interp_matrix(lo_f, whi, size: int, nbins: int, s: int):
     """Mean-of-samples one-hot interpolation matrix (nbins, size).
@@ -302,7 +305,7 @@ def _roi_align_fwd_impl(feat, rois, pooled, scale, s, interpret):
         kernel,
         # every fwd grid step writes a disjoint out block — declaring all
         # three axes parallel lets Mosaic pipeline/overlap grid steps
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel"),
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -340,7 +343,7 @@ def _roi_align_bwd_impl(feat_shape, feat_dtype, rois, g, pooled, scale, s, inter
         kernel,
         # batch/channel blocks are independent; the roi axis carries the
         # accumulator read-modify-write and must stay sequential
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(

@@ -24,10 +24,16 @@ from mx_rcnn_tpu.core.checkpoint import (
     PreemptionGuard,
     latest_checkpoint,
     load_checkpoint,
+    load_restorable,
     prune_step_checkpoints,
     save_checkpoint,
 )
 from mx_rcnn_tpu.core.metrics import MetricTracker, Speedometer
+from mx_rcnn_tpu.core.resilience import (
+    DivergencePolicy,
+    GuardedLoop,
+    StepWatchdog,
+)
 from mx_rcnn_tpu.core.train import (
     create_train_state,
     make_lr_schedule,
@@ -92,6 +98,25 @@ def parse_args(argv=None):
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of steps 10-20 into "
                         "DIR (view with tensorboard/xprof)")
+    # resilience (core/resilience.py): divergence recovery + hang watchdog
+    p.add_argument("--step_timeout", type=float, default=0.0, metavar="SECS",
+                   help="wall-clock watchdog per train step: a step that "
+                        "exceeds this dumps a resumable checkpoint and "
+                        "exits with code 75 instead of hanging (0 = off)")
+    p.add_argument("--snapshot_every", type=int, default=10, metavar="N",
+                   help="refresh the guarded loop's host-side rollback "
+                        "snapshot every N accepted steps (1 = exact "
+                        "rollback; higher amortizes the device->host "
+                        "fetch on relay-attached TPUs)")
+    p.add_argument("--spike_factor", type=float, default=20.0,
+                   help="treat a step as diverged when its loss exceeds "
+                        "this multiple of the running EMA")
+    p.add_argument("--max_bad_batches", type=int, default=8,
+                   help="abort (TrainingDiverged) after this many batches "
+                        "are skipped via rollback")
+    p.add_argument("--loader_failure_budget", type=int, default=None,
+                   help="abort after this many records fail to load "
+                        "(default: max(32, 1%% of the roidb))")
     return p.parse_args(argv)
 
 
@@ -165,6 +190,7 @@ def train_net(args):
             distributed.process_slice(global_batch)
             if jax.process_count() > 1 else None
         ),
+        failure_budget=args.loader_failure_budget,
     )
     steps_per_epoch = max(len(loader), 1)
 
@@ -196,33 +222,44 @@ def train_net(args):
     state = create_train_state(params, tx)
     begin_epoch = 0
     begin_batch = 0
-    if args.resume:
-        multi = jax.process_count() > 1
-        last = latest_checkpoint(args.prefix)
-        if multi:
-            # checkpoints are written by process 0 only; on per-host disks
-            # the others may see nothing (or stale dirs), so the resume
-            # point is process 0's decision everywhere — divergent
-            # epoch/batch counters would desync the collectives
-            from jax.experimental import multihost_utils
+    if args.resume and jax.process_count() == 1:
+        # single-host: restore the newest VERIFIABLE dump, falling back
+        # past corrupt/uncommitted ones (a kill mid-save leaves only an
+        # orphaned .tmp that the manifest check already skips)
+        found = load_restorable(args.prefix, state)
+        if found is not None:
+            (epoch, begin_batch), state = found
+            begin_epoch = epoch
+            loader.epoch = begin_epoch
+            loader.skip_batches = begin_batch
+            logger.info("resumed from epoch %d batch %d", epoch, begin_batch)
+    elif args.resume:
+        # multi-host: checkpoints are written by process 0 only; on
+        # per-host disks the others may see nothing (or stale dirs), so
+        # the resume point is process 0's decision everywhere — divergent
+        # epoch/batch counters would desync the collectives.
+        # latest_checkpoint already verified the manifest, so process 0's
+        # pick is loadable short of on-disk bit rot (which raises loudly
+        # as CheckpointCorrupt rather than desyncing the fleet).
+        from jax.experimental import multihost_utils
 
-            agreed = multihost_utils.broadcast_one_to_all(
-                np.asarray(last if last is not None else (-1, -1), np.int32)
-            )
-            last = tuple(int(x) for x in agreed)
-            if last == (-1, -1):
-                last = None
+        last = latest_checkpoint(args.prefix)
+        agreed = multihost_utils.broadcast_one_to_all(
+            np.asarray(last if last is not None else (-1, -1), np.int32)
+        )
+        last = tuple(int(x) for x in agreed)
+        if last == (-1, -1):
+            last = None
         if last is not None:
             epoch, begin_batch = last
-            if not multi or jax.process_index() == 0:
+            if jax.process_index() == 0:
                 state = load_checkpoint(args.prefix, epoch, state, begin_batch)
-            if multi:
-                # ship process 0's restored state to hosts whose local
-                # disk has no checkpoint (all processes must enter
-                # replicate() with identical values)
-                state = multihost_utils.broadcast_one_to_all(
-                    jax.device_get(state)
-                )
+            # ship process 0's restored state to hosts whose local
+            # disk has no checkpoint (all processes must enter
+            # replicate() with identical values)
+            state = multihost_utils.broadcast_one_to_all(
+                jax.device_get(state)
+            )
             begin_epoch = epoch
             # replay the same shuffle stream a fresh run would have used
             # at this epoch (the loader keys its RNG on seed + epoch);
@@ -246,6 +283,39 @@ def train_net(args):
 
     if jax.process_index() == 0:
         save_run_meta(args.prefix, cfg)
+
+    # resilience: every step runs under the guarded loop (NaN/spike →
+    # retry with LR backoff → rollback + skip); an optional watchdog
+    # turns a hung step into a resumable checkpoint + exit 75 instead of
+    # an rc=124 external kill (the MULTICHIP_r04 failure mode)
+    guard = GuardedLoop(
+        step_fn,
+        policy=DivergencePolicy(
+            spike_factor=args.spike_factor,
+            max_bad_batches=args.max_bad_batches,
+        ),
+        snapshot_every=args.snapshot_every,
+        place_fn=(lambda t: replicate(t, mesh)) if use_mesh else None,
+    )
+    loop_pos = {"epoch": begin_epoch, "batch": begin_batch}
+    if args.step_timeout > 0:
+        def _watchdog_dump():
+            snap = guard.last_snapshot
+            if snap is None or jax.process_index() != 0:
+                return None
+            # the snapshot lags the stream by steps_since_snapshot —
+            # name the dump at ITS position so resume re-consumes the
+            # un-snapshotted batches rather than silently skipping them
+            batch_pos = max(
+                0, loop_pos["batch"] - guard.steps_since_snapshot
+            )
+            return save_checkpoint(
+                args.prefix, snap, loop_pos["epoch"], batch_pos
+            )
+
+        guard.watchdog = StepWatchdog(
+            args.step_timeout, dump_fn=_watchdog_dump
+        )
 
     STOP_VOTE_EVERY = 10
 
@@ -276,11 +346,12 @@ def train_net(args):
     total_steps = 0
     tracing = False
     preempted = False
-    guard = PreemptionGuard()
+    preempt_guard = PreemptionGuard()
     try:
         for epoch in range(begin_epoch, args.epochs):
             batch_in_epoch = begin_batch if epoch == begin_epoch else 0
             for batch in loader:
+                loop_pos["epoch"], loop_pos["batch"] = epoch, batch_in_epoch
                 if use_mesh:
                     batch = distributed.globalize_batch(batch, mesh)
                 # profiler window: skip compile/warmup, capture steady
@@ -288,10 +359,9 @@ def train_net(args):
                 if args.profile and total_steps == 10:
                     jax.profiler.start_trace(args.profile)
                     tracing = True
-                state, aux = step_fn(state, batch, rng)
-                tracker.update(
-                    {k: float(v) for k, v in jax.device_get(aux).items()}
-                )
+                state, aux, step_ok = guard.step(state, batch, rng)
+                if step_ok:
+                    tracker.update({k: float(v) for k, v in aux.items()})
                 total_steps += 1
                 batch_in_epoch += 1
                 if args.profile and total_steps == 20:
@@ -299,7 +369,7 @@ def train_net(args):
                     tracing = False
                     logger.info("profiler trace written to %s", args.profile)
                 speedo(epoch, total_steps, tracker)
-                if _stop_agreed(guard.should_stop, total_steps):
+                if _stop_agreed(preempt_guard.should_stop, total_steps):
                     # preemption: mid-epoch checkpoint resume picks up
                     preempted = True
                     if jax.process_index() == 0:
@@ -326,7 +396,16 @@ def train_net(args):
             if args.max_steps and total_steps >= args.max_steps:
                 break
     finally:
-        guard.uninstall()
+        preempt_guard.uninstall()
+        if guard.skipped_batches or loader.record_failures:
+            logger.warning(
+                "resilience summary: %d poison batch(es) skipped via "
+                "rollback (%d step retries), %d record(s) failed to load "
+                "(%d substituted, %d batches dropped)",
+                guard.skipped_batches, guard.retried_steps,
+                loader.record_failures, loader.substituted_records,
+                loader.dropped_batches,
+            )
         if tracing:
             # run ended inside the capture window — flush what we have
             jax.profiler.stop_trace()

@@ -1,0 +1,173 @@
+"""Deterministic fault injection for the resilience test harness.
+
+Every injector is driven by the ``MX_RCNN_FAULTS`` env var (so a child
+process — the watchdog subprocess test — inherits the spec) and is
+keyed on deterministic run coordinates (train step index, roidb record
+index, save-call ordinal), never on wall clock or an RNG: a replayed run
+injects the identical faults at the identical points, which is what lets
+``tests/test_resilience.py`` assert exact recovery behavior.
+
+Spec grammar — comma-separated entries ``KIND@KEY[xTIMES][:ARG]``::
+
+    nan_loss@STEP          NaN the observed loss at guarded step STEP
+                           (every attempt: a poison batch)
+    spike@STEP[xN][:F]     multiply the loss by F (default 1e4) at STEP;
+                           xN bounds how many attempts fire (x1 = a
+                           transient spike that a retry survives)
+    record_fail@IDX[xN]    raise IOError loading roidb record IDX
+                           (unbounded = permanently corrupt record;
+                           x2 = two flaky reads, then the retry succeeds)
+    save_crash@NCALL       raise SimulatedCrash inside the NCALLth
+                           save_checkpoint (1-based), after the data is
+                           written but before the atomic commit — the
+                           "killed mid-save" torn state
+    stall@STEP:SECONDS     sleep SECONDS at guarded step STEP (drives the
+                           step past the watchdog deadline)
+
+Example::
+
+    MX_RCNN_FAULTS="nan_loss@5,record_fail@3,save_crash@2,stall@7:30"
+
+Injection sites are no-ops (one env lookup) when the variable is unset,
+so production paths pay nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+ENV_VAR = "MX_RCNN_FAULTS"
+
+
+class InjectedFault(IOError):
+    """Raised by the record-load injector (an IOError so real retry
+    handling treats it exactly like a disk/decode failure)."""
+
+
+class SimulatedCrash(RuntimeError):
+    """Raised by the save injector: stands in for SIGKILL mid-save (the
+    writer cannot clean up, the ``.tmp`` dir is left uncommitted)."""
+
+
+@dataclass
+class _Fault:
+    kind: str
+    key: int
+    times: Optional[int]  # None = unbounded
+    arg: float
+    fired: int = 0
+
+    def fire(self) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        self.fired += 1
+        return True
+
+
+@dataclass
+class _Registry:
+    spec: str
+    faults: List[_Fault] = field(default_factory=list)
+    save_calls: int = 0
+
+
+_registry: Optional[_Registry] = None
+
+
+def _parse(spec: str) -> List[_Fault]:
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        kind, _, rest = entry.partition("@")
+        arg_s = None
+        if ":" in rest:
+            rest, _, arg_s = rest.partition(":")
+        times: Optional[int] = None
+        if "x" in rest:
+            rest, _, times_s = rest.partition("x")
+            times = int(times_s)
+        defaults = {"spike": 1e4, "stall": 5.0}
+        out.append(
+            _Fault(
+                kind=kind,
+                key=int(rest),
+                times=times,
+                arg=float(arg_s) if arg_s is not None else defaults.get(kind, 0.0),
+            )
+        )
+    return out
+
+
+def _active() -> Optional[_Registry]:
+    """Parse-once registry, re-parsed (with fresh fire counts) whenever
+    the env var's value changes — monkeypatch.setenv in a test starts a
+    clean injection state."""
+    global _registry
+    spec = os.environ.get(ENV_VAR, "")
+    if not spec:
+        _registry = None
+        return None
+    if _registry is None or _registry.spec != spec:
+        _registry = _Registry(spec=spec, faults=_parse(spec))
+    return _registry
+
+
+def reset() -> None:
+    """Forget fire counts (tests reusing an identical spec string)."""
+    global _registry
+    _registry = None
+
+
+def corrupt_loss(step: int, loss: float) -> float:
+    """GuardedLoop's observed-loss hook: NaN or spike injection."""
+    reg = _active()
+    if reg is None:
+        return loss
+    for f in reg.faults:
+        if f.key != step:
+            continue
+        if f.kind == "nan_loss" and f.fire():
+            return float("nan")
+        if f.kind == "spike" and f.fire():
+            return loss * f.arg if loss else f.arg
+    return loss
+
+
+def fail_record(index: int) -> None:
+    """Loader hook: raise for a corrupt/missing record."""
+    reg = _active()
+    if reg is None:
+        return
+    for f in reg.faults:
+        if f.kind == "record_fail" and f.key == index and f.fire():
+            raise InjectedFault(f"injected read failure for record {index}")
+
+
+def crash_save() -> None:
+    """Checkpoint hook, called once per save_checkpoint AFTER the data
+    write but BEFORE the atomic commit."""
+    reg = _active()
+    if reg is None:
+        return
+    reg.save_calls += 1
+    for f in reg.faults:
+        if f.kind == "save_crash" and f.key == reg.save_calls and f.fire():
+            raise SimulatedCrash(
+                f"injected crash during save #{reg.save_calls} "
+                f"(uncommitted .tmp left behind)"
+            )
+
+
+def stall(step: int) -> None:
+    """GuardedLoop hook: wedge this step (watchdog exercise)."""
+    reg = _active()
+    if reg is None:
+        return
+    for f in reg.faults:
+        if f.kind == "stall" and f.key == step and f.fire():
+            time.sleep(f.arg)

@@ -98,7 +98,15 @@ def parse(args):
         totals = defaultdict(float)  # name -> total ps
         counts = defaultdict(int)
         span_lo, span_hi = None, 0
-        for line in plane.lines:
+        # aggregate the 'XLA Ops' line only: device planes can carry
+        # 'XLA Modules'/'Steps' lines whose events NEST the op events —
+        # summing every line would double-count busy time (ADVICE r5 #1)
+        op_lines = [ln for ln in plane.lines if ln.name == "XLA Ops"]
+        if not op_lines:
+            print(f"(plane {plane.name}: no 'XLA Ops' line — summing "
+                  f"all {len(plane.lines)} lines)")
+            op_lines = list(plane.lines)
+        for line in op_lines:
             for ev in line.events:
                 name = ev_meta[ev.metadata_id].name
                 totals[name] += ev.duration_ps
@@ -120,8 +128,9 @@ def parse(args):
             print(f"{name[:72]:<72s} {ms:9.2f} {ms/args.steps:9.3f} "
                   f"{counts[name]:6d} {100*ps/sum(totals.values()):6.1f}")
         rest = sum(ps for _, ps in rows[args.top:]) / 1e9
+        rest_n = sum(counts[n] for n, _ in rows[args.top:])
         print(f"{'(everything else)':<72s} {rest:9.2f} "
-              f"{rest/args.steps:9.3f} {sum(counts.values()):6d}")
+              f"{rest/args.steps:9.3f} {rest_n:6d}")
 
 
 def main():

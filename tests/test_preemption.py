@@ -1,6 +1,9 @@
 """Preemption-safe checkpointing (SURVEY §5.4 upgrade: the reference had
-manual epoch-granular restart only)."""
+manual epoch-granular restart only) + crash-safe commit semantics: saves
+are tmp-write + manifest + atomic rename, and every reader skips
+uncommitted/corrupt dumps (ISSUE 1)."""
 
+import json
 import os
 import signal
 
@@ -8,7 +11,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from mx_rcnn_tpu.core.checkpoint import (
+    MANIFEST,
     PreemptionGuard,
+    is_committed,
     latest_checkpoint,
     load_checkpoint,
     save_checkpoint,
@@ -24,22 +29,49 @@ def _state(v: float) -> TrainState:
     )
 
 
+def _commit_dir(prefix: str, name: str) -> str:
+    """A minimal committed checkpoint dir: empty but manifest-valid —
+    ordering tests only care about name parsing + commit status."""
+    path = os.path.join(prefix, name)
+    os.makedirs(path)
+    with open(os.path.join(path, MANIFEST), "w") as f:
+        json.dump({"format": 1, "files": {}}, f)
+    return path
+
+
 def test_latest_checkpoint_ordering(tmp_path):
     p = str(tmp_path)
-    os.makedirs(os.path.join(p, "epoch_0001"))
+    _commit_dir(p, "epoch_0001")
     assert latest_checkpoint(p) == (1, 0)
     # a preemption dump inside epoch 1 is newer than epoch_0001
-    os.makedirs(os.path.join(p, "step_0001_000042"))
+    _commit_dir(p, "step_0001_000042")
     assert latest_checkpoint(p) == (1, 42)
     # the next epoch boundary is newer still
-    os.makedirs(os.path.join(p, "epoch_0002"))
+    _commit_dir(p, "epoch_0002")
     assert latest_checkpoint(p) == (2, 0)
     assert latest_checkpoint(str(tmp_path / "missing")) is None
 
 
+def test_latest_checkpoint_skips_uncommitted(tmp_path):
+    """A bare dir (no manifest: killed before commit, or foreign) and an
+    orphaned .tmp must never be selected over a verified dump."""
+    p = str(tmp_path)
+    _commit_dir(p, "epoch_0001")
+    os.makedirs(os.path.join(p, "epoch_0002"))          # no manifest
+    os.makedirs(os.path.join(p, "epoch_0003.tmp"))      # interrupted save
+    assert latest_checkpoint(p) == (1, 0)
+    assert not is_committed(os.path.join(p, "epoch_0002"))
+
+
 def test_step_checkpoint_roundtrip(tmp_path):
     p = str(tmp_path / "ckpt")
-    save_checkpoint(p, _state(7.0), epoch=2, batch_in_epoch=5)
+    path = save_checkpoint(p, _state(7.0), epoch=2, batch_in_epoch=5)
+    assert os.path.basename(path) == "step_0002_000005"
+    assert is_committed(path)
+    assert not os.path.isdir(path + ".tmp")  # tmp was renamed away
+    man = json.load(open(os.path.join(path, MANIFEST)))
+    assert man["epoch"] == 2 and man["batch_in_epoch"] == 5
+    assert man["step"] == 7 and man["checksum"]
     assert latest_checkpoint(p) == (2, 5)
     got = load_checkpoint(p, 2, _state(0.0), batch_in_epoch=5)
     np.testing.assert_array_equal(np.asarray(got.params["w"]), 7.0)
@@ -86,6 +118,9 @@ def test_prune_step_checkpoints(tmp_path):
     p = str(tmp_path)
     for d in ["epoch_0001", "step_0001_000003", "step_0002_000007", "junk"]:
         os.makedirs(os.path.join(p, d))
+    # orphaned partial saves are pruned regardless of age
+    os.makedirs(os.path.join(p, "epoch_0002.tmp"))
+    os.makedirs(os.path.join(p, "step_0002_000009.tmp"))
     prune_step_checkpoints(p, up_to_epoch=1)
     left = sorted(os.listdir(p))
     assert left == ["epoch_0001", "junk", "step_0002_000007"]

@@ -1,0 +1,470 @@
+"""Fault-injection resilience suite (ISSUE 1): exercises every recovery
+path of core/resilience.py + crash-safe checkpointing + loader fault
+tolerance on CPU, deterministically, via utils/faults.py injectors.
+
+Fast by construction — the guarded-loop tests drive fake numpy step
+functions (no model compiles), the loader tests use the synthetic
+dataset, and the one subprocess test (watchdog exit code) runs a
+trivial step.  Rides tier-1 (no ``slow`` marker; ``make resilience``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.core.checkpoint import (
+    MANIFEST,
+    CheckpointCorrupt,
+    is_committed,
+    latest_checkpoint,
+    load_checkpoint,
+    load_restorable,
+    prune_step_checkpoints,
+    save_checkpoint,
+)
+from mx_rcnn_tpu.core.resilience import (
+    WATCHDOG_EXIT_CODE,
+    DivergencePolicy,
+    GuardedLoop,
+    RetryPolicy,
+    StepWatchdog,
+    TrainingDiverged,
+)
+from mx_rcnn_tpu.core.train import TrainState
+from mx_rcnn_tpu.data.loader import LoaderFaultBudgetExceeded, TrainLoader
+from mx_rcnn_tpu.utils import faults
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _state(w: float = 1.0) -> TrainState:
+    return TrainState(np.int32(0), {"w": np.float32(w)}, ())
+
+
+def _good_step(state, batch, rng, lr_scale=None):
+    """w <- 0.9 w; loss = new w (positive, decreasing)."""
+    w = np.float32(np.asarray(state.params["w"]) * 0.9)
+    return TrainState(state.step + 1, {"w": w}, ()), {"loss": w}
+
+
+RNG = jax.random.key(0)
+
+
+# ---------------------------------------------------------------- RetryPolicy
+
+def test_retry_policy_bounded_and_deterministic():
+    seen = []
+
+    def flaky(attempt):
+        seen.append(attempt)
+        if attempt < 2:
+            raise IOError("flaky")
+        return "ok"
+
+    assert RetryPolicy(tries=3).run(flaky) == "ok"
+    assert seen == [0, 1, 2]
+
+    with pytest.raises(IOError):
+        RetryPolicy(tries=2).run(lambda a: (_ for _ in ()).throw(IOError()))
+
+
+# ---------------------------------------------------------------- GuardedLoop
+
+def test_guard_accepts_normal_steps():
+    guard = GuardedLoop(_good_step)
+    state = _state(1.0)
+    for _ in range(10):
+        state, aux, ok = guard.step(state, {}, RNG)
+        assert ok and np.isfinite(aux["loss"])
+    assert guard.skipped_batches == 0 and guard.retried_steps == 0
+    np.testing.assert_allclose(float(state.params["w"]), 0.9**10, rtol=1e-5)
+
+
+def test_guard_nan_poison_batch_rolls_back_and_skips():
+    """Recovery path (1): a poison batch NaNs the state on every attempt
+    — the guard rolls back to the pre-batch snapshot and skips it, and
+    the run finishes with a finite loss."""
+    lr_scales = []
+
+    def step(state, batch, rng, lr_scale=None):
+        lr_scales.append(lr_scale)
+        if batch.get("poison"):
+            bad = np.float32("nan")
+            return TrainState(state.step + 1, {"w": bad}, ()), {"loss": bad}
+        return _good_step(state, batch, rng)
+
+    guard = GuardedLoop(
+        step, policy=DivergencePolicy(retries=2, warmup_steps=0)
+    )
+    state = _state(1.0)
+    for _ in range(3):
+        state, aux, ok = guard.step(state, {}, RNG)
+        assert ok
+    w_before = float(np.asarray(state.params["w"]))
+
+    state, aux, ok = guard.step(state, {"poison": True}, RNG)
+    assert not ok
+    # rolled back exactly (snapshot_every=1): the poison update is gone
+    assert float(np.asarray(state.params["w"])) == pytest.approx(w_before)
+    assert guard.skipped_batches == 1 and guard.rollbacks == 1
+    assert guard.retried_steps == 3  # initial attempt + 2 retries
+    # retries carried exponential LR backoff
+    assert lr_scales[-3:] == [None, 0.5, 0.25]
+
+    for _ in range(3):
+        state, aux, ok = guard.step(state, {}, RNG)
+        assert ok
+    assert np.isfinite(guard.last_loss)
+
+
+def test_guard_spike_retry_recovers_with_lr_backoff():
+    """A transient loss spike survives a damped retry — no rollback."""
+
+    def step(state, batch, rng, lr_scale=None):
+        if batch.get("spiky") and lr_scale is None:
+            w = np.float32(np.asarray(state.params["w"]))
+            return TrainState(state.step + 1, {"w": w}, ()), {
+                "loss": np.float32(1e6)
+            }
+        return _good_step(state, batch, rng)
+
+    guard = GuardedLoop(
+        step,
+        policy=DivergencePolicy(retries=2, warmup_steps=2, spike_factor=20.0),
+    )
+    state = _state(1.0)
+    for _ in range(4):
+        state, aux, ok = guard.step(state, {}, RNG)
+    state, aux, ok = guard.step(state, {"spiky": True}, RNG)
+    assert ok  # accepted on the damped retry
+    assert guard.retried_steps == 1 and guard.skipped_batches == 0
+    assert np.isfinite(aux["loss"]) and aux["loss"] < 1.0
+
+
+def test_guard_divergence_budget_aborts():
+    def nan_step(state, batch, rng, lr_scale=None):
+        bad = np.float32("nan")
+        return TrainState(state.step + 1, {"w": bad}, ()), {"loss": bad}
+
+    guard = GuardedLoop(
+        nan_step,
+        policy=DivergencePolicy(retries=0, warmup_steps=0, max_bad_batches=2),
+    )
+    state = _state(1.0)
+    for _ in range(2):
+        state, _aux, ok = guard.step(state, {}, RNG)
+        assert not ok
+    with pytest.raises(TrainingDiverged):
+        guard.step(state, {}, RNG)
+
+
+def test_guard_stale_snapshot_rollback(monkeypatch):
+    """snapshot_every=3: a rollback restores the last snapshot (losing at
+    most snapshot_every-1 accepted steps), never a poisoned state."""
+
+    def step(state, batch, rng, lr_scale=None):
+        if batch.get("poison"):
+            bad = np.float32("nan")
+            return TrainState(state.step + 1, {"w": bad}, ()), {"loss": bad}
+        return _good_step(state, batch, rng)
+
+    guard = GuardedLoop(
+        step,
+        policy=DivergencePolicy(retries=0, warmup_steps=0),
+        snapshot_every=3,
+    )
+    state = _state(1.0)
+    for _ in range(4):
+        state, _aux, ok = guard.step(state, {}, RNG)
+        assert ok
+    state, _aux, ok = guard.step(state, {"poison": True}, RNG)
+    assert not ok
+    # snapshot was refreshed at entry of step 3 → state after 3 steps
+    np.testing.assert_allclose(
+        float(np.asarray(state.params["w"])), 0.9**3, rtol=1e-5
+    )
+
+
+def test_guard_env_injected_nan(monkeypatch):
+    """The env-driven injector drives the same rollback path end-to-end:
+    MX_RCNN_FAULTS=nan_loss@3 poisons guarded step 3, the run completes
+    with a finite final loss (acceptance criterion 1)."""
+    monkeypatch.setenv(faults.ENV_VAR, "nan_loss@3")
+    faults.reset()
+    guard = GuardedLoop(
+        _good_step, policy=DivergencePolicy(retries=1, warmup_steps=0)
+    )
+    state = _state(1.0)
+    for _ in range(8):
+        state, aux, ok = guard.step(state, {}, RNG)
+    assert guard.skipped_batches == 1 and guard.rollbacks == 1
+    assert np.isfinite(guard.last_loss)
+    assert np.isfinite(float(np.asarray(state.params["w"])))
+
+
+def test_guard_env_injected_transient_spike(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "spike@4x1:1000")
+    faults.reset()
+    guard = GuardedLoop(
+        _good_step, policy=DivergencePolicy(retries=2, warmup_steps=2)
+    )
+    state = _state(1.0)
+    for _ in range(8):
+        state, aux, ok = guard.step(state, {}, RNG)
+        assert ok or guard.step_index - 1 == 4
+    # the x1 spike fired once; the first retry saw the clean loss
+    assert guard.retried_steps == 1 and guard.skipped_batches == 0
+
+
+# ----------------------------------------------------------------- TrainLoader
+
+def _roidb(n=8):
+    from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+
+    return SyntheticDataset(
+        num_images=n, num_classes=4, image_size=(128, 128), max_boxes=2
+    ).gt_roidb()
+
+
+def _cfg():
+    from tests.test_loader import small_cfg
+
+    return small_cfg()
+
+
+def test_loader_substitutes_failed_record(monkeypatch):
+    """Recovery path (3): a permanently corrupt record doesn't kill the
+    prefetch worker — its slot is filled by the batch's first good
+    record, deterministically, and the counters record the damage."""
+    monkeypatch.setenv(faults.ENV_VAR, "record_fail@2")
+    faults.reset()
+    loader = TrainLoader(
+        _roidb(), _cfg(), 2, shuffle=False, prefetch=2, failure_budget=4
+    )
+    batches = list(loader)
+    assert len(batches) == 4  # no batch lost
+    assert loader.record_failures == 1  # == injected failures
+    assert loader.substituted_records == 1
+    # batch [2,3]: record 2's slot was filled with record 3
+    np.testing.assert_array_equal(
+        batches[1]["images"][0], batches[1]["images"][1]
+    )
+    np.testing.assert_array_equal(
+        batches[1]["gt_boxes"][0], batches[1]["gt_boxes"][1]
+    )
+    np.testing.assert_array_equal(batches[1]["sample_seeds"], [3, 3])
+
+
+def test_loader_retry_recovers_flaky_record(monkeypatch):
+    """Two flaky reads then success: RetryPolicy absorbs the fault and
+    the stream is byte-identical to an unfaulted run."""
+    want = list(TrainLoader(_roidb(), _cfg(), 2, shuffle=False, prefetch=0))
+
+    monkeypatch.setenv(faults.ENV_VAR, "record_fail@1x2")
+    faults.reset()
+    loader = TrainLoader(
+        _roidb(), _cfg(), 2, shuffle=False, prefetch=0,
+        retry=RetryPolicy(tries=3),
+    )
+    got = list(loader)
+    assert loader.record_failures == 0 and loader.substituted_records == 0
+    assert len(got) == len(want)
+    for a, b in zip(got, want):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_loader_drops_batch_when_all_records_fail(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "record_fail@0,record_fail@1")
+    faults.reset()
+    loader = TrainLoader(
+        _roidb(), _cfg(), 2, shuffle=False, prefetch=0, failure_budget=4
+    )
+    batches = list(loader)
+    assert len(batches) == 3 and loader.dropped_batches == 1
+    assert loader.record_failures == 2
+
+
+def test_loader_failure_budget_aborts(monkeypatch):
+    """Bounded data loss: more failed records than the budget aborts the
+    run instead of silently training on a shrinking dataset."""
+    monkeypatch.setenv(faults.ENV_VAR, "record_fail@0,record_fail@4")
+    faults.reset()
+    loader = TrainLoader(
+        _roidb(), _cfg(), 2, shuffle=False, prefetch=0, failure_budget=1
+    )
+    with pytest.raises(LoaderFaultBudgetExceeded):
+        list(loader)
+
+
+# ----------------------------------------------------------- crash-safe saves
+
+def test_crash_mid_save_leaves_uncommitted_tmp(tmp_path, monkeypatch):
+    """Recovery path (2): a kill between the data write and the commit
+    leaves an orphaned .tmp; every reader falls back to the previous
+    verified dump, and prune removes the orphan."""
+    p = str(tmp_path / "ckpt")
+    save_checkpoint(p, _state(1.0), epoch=1)
+
+    monkeypatch.setenv(faults.ENV_VAR, "save_crash@1")
+    faults.reset()
+    with pytest.raises(faults.SimulatedCrash):
+        save_checkpoint(p, _state(2.0), epoch=2)
+    assert os.path.isdir(os.path.join(p, "epoch_0002.tmp"))
+    assert not os.path.isdir(os.path.join(p, "epoch_0002"))
+
+    # resume picks the previous verified checkpoint
+    assert latest_checkpoint(p) == (1, 0)
+    (pos, restored) = load_restorable(p, _state(0.0))
+    assert pos == (1, 0)
+    assert float(np.asarray(restored.params["w"])) == 1.0
+
+    prune_step_checkpoints(p, up_to_epoch=0)
+    assert not os.path.isdir(os.path.join(p, "epoch_0002.tmp"))
+
+
+def test_truncated_checkpoint_skipped(tmp_path):
+    p = str(tmp_path / "ckpt")
+    save_checkpoint(p, _state(1.0), epoch=1)
+    newer = save_checkpoint(p, _state(2.0), epoch=2)
+
+    man = json.load(open(os.path.join(newer, MANIFEST)))
+    victim = next(
+        os.path.join(newer, rel)
+        for rel, size in man["files"].items() if size > 0
+    )
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) - 1)
+
+    assert not is_committed(newer)
+    assert latest_checkpoint(p) == (1, 0)
+    pos, restored = load_restorable(p, _state(0.0))
+    assert pos == (1, 0)
+    assert float(np.asarray(restored.params["w"])) == 1.0
+
+
+def test_missing_manifest_skipped(tmp_path):
+    p = str(tmp_path / "ckpt")
+    save_checkpoint(p, _state(1.0), epoch=1)
+    newer = save_checkpoint(p, _state(2.0), epoch=2)
+    os.remove(os.path.join(newer, MANIFEST))
+    assert latest_checkpoint(p) == (1, 0)
+
+
+def test_checksum_mismatch_falls_back(tmp_path):
+    """Sizes intact but content wrong (bit rot): the load-time checksum
+    catches it and load_restorable falls back to the older dump."""
+    p = str(tmp_path / "ckpt")
+    save_checkpoint(p, _state(1.0), epoch=1)
+    newer = save_checkpoint(p, _state(2.0), epoch=2)
+    mpath = os.path.join(newer, MANIFEST)
+    man = json.load(open(mpath))
+    man["checksum"] = "0" * 64
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+
+    assert latest_checkpoint(p) == (2, 0)  # size check alone passes
+    with pytest.raises(CheckpointCorrupt):
+        load_checkpoint(p, 2, _state(0.0))
+    pos, restored = load_restorable(p, _state(0.0))
+    assert pos == (1, 0)
+    assert float(np.asarray(restored.params["w"])) == 1.0
+
+
+# -------------------------------------------------------------- StepWatchdog
+
+def test_watchdog_fires_and_dumps_in_process():
+    import time
+
+    fired = []
+    dog = StepWatchdog(
+        0.05, dump_fn=lambda: fired.append("dump") or "/tmp/x",
+        exit_fn=lambda code: fired.append(code),
+    )
+    dog.arm("7")
+    time.sleep(0.4)
+    assert fired == ["dump", WATCHDOG_EXIT_CODE]
+    dog.disarm()
+
+    # a disarmed watchdog never fires
+    fired.clear()
+    dog.arm("8")
+    dog.disarm()
+    time.sleep(0.2)
+    assert fired == []
+
+
+_WATCHDOG_SCRIPT = """
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from mx_rcnn_tpu.core.checkpoint import save_checkpoint
+from mx_rcnn_tpu.core.resilience import GuardedLoop, StepWatchdog
+from mx_rcnn_tpu.core.train import TrainState
+
+prefix = sys.argv[1]
+
+def step_fn(state, batch, rng):
+    return (TrainState(state.step + 1, state.params, state.opt_state),
+            {"loss": np.float32(1.0)})
+
+state = TrainState(jnp.zeros((), jnp.int32), {"w": np.ones((3,), np.float32)}, ())
+guard = GuardedLoop(step_fn)
+pos = {"batch": 0}
+
+def dump():
+    return save_checkpoint(
+        prefix, guard.last_snapshot, 0,
+        max(1, pos["batch"] - guard.steps_since_snapshot))
+
+guard.watchdog = StepWatchdog(1.0, dump_fn=dump)
+rng = jax.random.key(0)
+for i in range(6):
+    pos["batch"] = i
+    state, aux, ok = guard.step(state, {}, rng)
+print("COMPLETED-WITHOUT-WATCHDOG")
+"""
+
+
+def test_watchdog_aborts_stalled_step_with_distinct_code(tmp_path):
+    """Recovery path (4): a stalled step (MX_RCNN_FAULTS=stall@2:30)
+    trips the watchdog, which dumps a resumable mid-epoch checkpoint and
+    exits with WATCHDOG_EXIT_CODE — not a hang, not timeout(1)'s 124."""
+    assert WATCHDOG_EXIT_CODE not in (0, 70, 124)
+    script = tmp_path / "stall_run.py"
+    script.write_text(_WATCHDOG_SCRIPT)
+    prefix = str(tmp_path / "ckpt")
+    env = dict(
+        os.environ,
+        MX_RCNN_FAULTS="stall@2:30",
+        PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script), prefix],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == WATCHDOG_EXIT_CODE, (
+        proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:]
+    )
+    assert "COMPLETED-WITHOUT-WATCHDOG" not in proc.stdout
+    assert "StepWatchdog" in proc.stderr
+    # the dump is a verified, resumable mid-epoch checkpoint at the
+    # stalled step's stream position
+    assert latest_checkpoint(prefix) == (0, 2)
+    restored = load_checkpoint(prefix, 0, _state(0.0), batch_in_epoch=2)
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), 1.0)
