@@ -131,6 +131,89 @@ def bench_one(
     }
 
 
+def bench_serve(
+    network: str,
+    requests: int,
+    concurrency: int,
+    max_batch: int,
+    linger_ms: float,
+    small: bool = True,
+) -> tuple:
+    """Online-serving measurement: drive the dynamic-batching engine with
+    the deterministic synthetic load generator and report latency,
+    throughput, occupancy, and the compile count that proves the shape
+    ladder held (misses == len(ladder), and not one more).
+
+    → (records, report): the per-metric JSON-line records plus the full
+    engine snapshot for the artifact.  Serving has no reference baseline
+    (the MXNet repo had no online path), so ``vs_baseline`` is null.
+    """
+    import jax
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.loadgen import DEFAULT_SIZES, run_load
+    from mx_rcnn_tpu.serve.runner import ServeRunner
+    from mx_rcnn_tpu.tools.serve import small_config
+
+    if small:
+        cfg = small_config(network)
+        sizes = ((72, 96), (96, 128), (64, 80))
+    else:
+        cfg = generate_config(network, "PascalVOC")
+        sizes = DEFAULT_SIZES
+    model = build_model(cfg)
+    h, w = cfg.SHAPE_BUCKETS[0]
+    params = model.init(
+        {"params": jax.random.key(0)},
+        np.zeros((1, h, w, 3), np.float32),
+        np.array([[h, w, 1.0]], np.float32),
+        train=False,
+    )["params"]
+    runner = ServeRunner(model, params, cfg, max_batch=max_batch)
+    with ServingEngine(runner, max_linger=linger_ms / 1000.0) as engine:
+        report = run_load(
+            engine, num_requests=requests, concurrency=concurrency,
+            sizes=sizes, seed=0,
+        )
+    eng = report["engine"]
+    tag = _METRIC_NAMES[network].replace("_e2e", "")
+    records = [
+        {
+            "metric": f"serve_p50_ms_{tag}",
+            "value": eng["latency"]["e2e"]["p50_ms"],
+            "unit": "ms",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"serve_p99_ms_{tag}",
+            "value": eng["latency"]["e2e"]["p99_ms"],
+            "unit": "ms",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"serve_imgs_per_sec_{tag}",
+            "value": report["imgs_per_sec"],
+            "unit": "imgs/sec",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"serve_batch_occupancy_{tag}",
+            "value": eng["batches"]["occupancy"],
+            "unit": "fraction",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"serve_compile_misses_{tag}",
+            "value": eng["compile"]["misses"],
+            "unit": "compiles",
+            "vs_baseline": None,
+        },
+    ]
+    return records, report
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -148,6 +231,21 @@ def main():
         help="bench every family; one JSON line each",
     )
     ap.add_argument(
+        "--serve", action="store_true",
+        help="bench the online serving engine instead of training",
+    )
+    # defaults chosen to SATURATE the engine (concurrency > in_flight *
+    # max_batch, linger visible next to CPU service times) so the
+    # occupancy number is a statement about the batcher, not the load
+    ap.add_argument("--serve_requests", type=int, default=64)
+    ap.add_argument("--serve_concurrency", type=int, default=16)
+    ap.add_argument("--serve_max_batch", type=int, default=4)
+    ap.add_argument("--serve_linger_ms", type=float, default=25.0)
+    ap.add_argument(
+        "--serve_full", action="store_true",
+        help="serve at the full config (default: tiny CPU-runnable one)",
+    )
+    ap.add_argument(
         "--out", default=None,
         help="also write the records as a JSON array artifact",
     )
@@ -156,6 +254,20 @@ def main():
     from mx_rcnn_tpu.utils.platform import enable_compile_cache
 
     enable_compile_cache()
+
+    if args.serve:
+        network = "resnet50" if args.network == "resnet" else args.network
+        records, report = bench_serve(
+            network, args.serve_requests, args.serve_concurrency,
+            args.serve_max_batch, args.serve_linger_ms,
+            small=not args.serve_full,
+        )
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
 
     families = _ALL_FAMILIES if args.all else (args.network,)
     records = []

@@ -22,7 +22,6 @@ import jax
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
-from mx_rcnn_tpu.native.hostops import nms_host
 from mx_rcnn_tpu.utils.bbox_stats import np_bbox_pred, np_clip_boxes
 
 logger = logging.getLogger(__name__)
@@ -38,7 +37,8 @@ class Predictor:
     instead of the full (B, R, K)+(B, R, 4K) head outputs.  Mask models
     skip it automatically (mask pasting needs full outputs on host)."""
 
-    def __init__(self, model, params, postprocess=None):
+    def __init__(self, model, params, postprocess=None, donate: bool = False,
+                 deterministic: bool = False):
         self.model = model
         self.params = params
 
@@ -57,7 +57,25 @@ class Predictor:
                 return postprocess(out, batch["im_info"], orig_hw)
             return out
 
-        self._fn = jax.jit(fwd)
+        # donate=True hands the input batch buffers to XLA (serving: the
+        # engine never reuses a dispatched batch, so the device can write
+        # outputs in place).  Off by default — the CPU runtime can't use
+        # donations and would log a warning per compile.
+        jit_kwargs = {}
+        if donate:
+            jit_kwargs["donate_argnums"] = (1,)
+        # deterministic=True (CPU): compile with the legacy XLA:CPU
+        # runtime, whose Eigen kernels accumulate each output cell's
+        # reduction serially — a SHAPE-INDEPENDENT order, so the same
+        # valid pixels produce bitwise-identical features on every
+        # shape-bucket canvas.  The default thunk runtime reassociates
+        # reductions per shape (~1e-6 on head outputs across buckets).
+        # Accelerator backends ignore the option (it is cpu-namespaced).
+        if deterministic and jax.default_backend() == "cpu":
+            jit_kwargs["compiler_options"] = {
+                "xla_cpu_use_thunk_runtime": False
+            }
+        self._fn = jax.jit(fwd, **jit_kwargs)
 
     def predict(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         return jax.device_get(self.predict_async(batch))
@@ -186,51 +204,31 @@ def pred_eval(
         """Accumulate detections for dataset image ``i`` from the
         ``k``-th slot of a (possibly batched) forward's outputs."""
         nonlocal all_masks, done
-        with_masks = False
-        mask_probs: Dict[int, np.ndarray] = {}
-        if "det_boxes" in out:
-            # device postprocess path: decode, unscale, clip, and
-            # per-class NMS all ran in the forward jit; boxes arrive in
-            # original image coordinates
-            for j in range(1, num_classes):
-                m = out["det_valid"][k][j - 1].astype(bool)
-                b = np.asarray(out["det_boxes"][k][j - 1][m])
-                s = np.asarray(out["det_scores"][k][j - 1][m])
-                all_boxes[j][i] = np.hstack([b, s[:, None]]).astype(np.float32)
-        else:
-            det = im_detect(
-                out, batch["im_info"][k], (rec["height"], rec["width"]), index=k
-            )
-            scores, boxes = det["scores"], det["boxes"]
-            with_masks = "mask_probs" in det
-            if with_masks and all_masks is None:
+        # the canonical per-image postprocess lives in serve/runner.py
+        # (one decode path shared by eval, demo, and the serving engine);
+        # function-level import: serve imports this module at top level
+        from mx_rcnn_tpu.serve.runner import (
+            cap_detections,
+            detections_from_output,
+        )
+
+        cls_dets, mask_probs = detections_from_output(
+            out, batch["im_info"][k], (rec["height"], rec["width"]),
+            cfg, num_classes, index=k, thresh=thresh,
+        )
+        # cap detections per image across classes (COCO: 100) BEFORE mask
+        # encoding — full-image mask work for detections the cap then
+        # discards dominated segm eval cost
+        cls_dets, mask_probs = cap_detections(
+            cls_dets, te.MAX_PER_IMAGE, mask_probs
+        )
+        for j in range(1, num_classes):
+            all_boxes[j][i] = cls_dets[j]
+        if mask_probs is not None:
+            if all_masks is None:
                 all_masks = [
                     [[] for _ in range(num_images)] for _ in range(num_classes)
                 ]
-            for j in range(1, num_classes):
-                keep = np.where(scores[:, j] > thresh)[0]
-                cls_dets = np.hstack(
-                    [boxes[keep, j * 4 : (j + 1) * 4], scores[keep, j : j + 1]]
-                ).astype(np.float32)
-                keep_nms = nms_host(cls_dets, te.NMS)
-                all_boxes[j][i] = cls_dets[keep_nms]
-                if with_masks:
-                    mask_probs[j] = det["mask_probs"][keep][keep_nms, :, :, j]
-        # cap detections per image across classes (COCO: 100)
-        if te.MAX_PER_IMAGE > 0:
-            all_scores = np.concatenate(
-                [all_boxes[j][i][:, 4] for j in range(1, num_classes)]
-            )
-            if len(all_scores) > te.MAX_PER_IMAGE:
-                cut = np.sort(all_scores)[-te.MAX_PER_IMAGE]
-                for j in range(1, num_classes):
-                    keep = all_boxes[j][i][:, 4] >= cut
-                    all_boxes[j][i] = all_boxes[j][i][keep]
-                    if with_masks:
-                        mask_probs[j] = mask_probs[j][keep]
-        if with_masks:
-            # paste/encode only the survivors — full-image mask work for
-            # detections the cap then discards dominated segm eval cost
             from mx_rcnn_tpu.eval.segm import mask_to_rle
 
             for j in range(1, num_classes):

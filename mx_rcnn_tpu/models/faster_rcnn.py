@@ -35,7 +35,7 @@ from mx_rcnn_tpu.ops.losses import (
     softmax_cross_entropy,
     weighted_smooth_l1,
 )
-from mx_rcnn_tpu.ops.proposal import propose
+from mx_rcnn_tpu.ops.proposal import _NEG_INF, anchor_grid_mask, propose
 from mx_rcnn_tpu.ops.roi_align import extract_roi_features_batched
 from mx_rcnn_tpu.ops.targets import assign_anchor, bbox_denorm_vectors, sample_rois
 
@@ -85,7 +85,8 @@ class FasterRCNN(nn.Module):
         )
 
     def _roi_features(
-        self, feat: jnp.ndarray, rois: jnp.ndarray, fwd_only: bool = False
+        self, feat: jnp.ndarray, rois: jnp.ndarray, fwd_only: bool = False,
+        valid_hw=None,
     ) -> jnp.ndarray:
         """(B, Hf, Wf, C) × (B, R, 4) → (B*R, D) head trunk features."""
         net = self.cfg.network
@@ -97,6 +98,7 @@ class FasterRCNN(nn.Module):
             1.0 / net.RCNN_FEAT_STRIDE,
             net.ROI_SAMPLE_RATIO,
             fwd_only=fwd_only,
+            valid_hw=valid_hw,
         )
         b, r = pooled.shape[0], pooled.shape[1]
         return self.top_head(pooled.reshape((b * r,) + pooled.shape[2:]))
@@ -227,11 +229,33 @@ class FasterRCNN(nn.Module):
         """
         cfg = self.cfg
         te = cfg.TEST
-        feat = self.backbone(images)
+        from mx_rcnn_tpu.models.layers import make_pad_mask, pad_feat_to_ladder
+
+        # serving invariance: re-zero bucket padding before every spatial
+        # op (frozen BN repaints zeros with its bias, so without this the
+        # edge convs read different neighbours on different canvases and
+        # detections depend on the bucket).  Inference-only — the train
+        # graph keeps its original arithmetic.
+        pad_mask = make_pad_mask(im_info, (images.shape[1], images.shape[2]))
+        feat = pad_mask(self.backbone(images, pad_mask=pad_mask))
         rpn_logits, rpn_deltas = self.rpn(feat)
         anchors = self._anchors(feat.shape[1], feat.shape[2])
 
         fg_scores = jax.nn.softmax(rpn_logits, axis=-1)[..., 1]
+        # kill anchors sitting on bucket padding: their scores come from
+        # zero-padded features, so keeping them would make the pre-NMS
+        # top-k set (and thus detections) depend on which bucket the
+        # image padded into.  Inference-only — train keeps the full pool
+        # (its tuned gate trajectories assume it).
+        grid_ok = jax.vmap(
+            lambda info: anchor_grid_mask(
+                ((feat.shape[1], feat.shape[2]),),
+                (cfg.network.RPN_FEAT_STRIDE,),
+                cfg.network.NUM_ANCHORS,
+                info,
+            )
+        )(im_info)
+        fg_scores = jnp.where(grid_ok, fg_scores, _NEG_INF)
         props = jax.vmap(
             lambda s, d, info: propose(
                 s,
@@ -245,7 +269,14 @@ class FasterRCNN(nn.Module):
             )
         )(fg_scores, rpn_deltas, im_info)
 
-        trunk = self._roi_features(feat, props.rois, fwd_only=True)
+        # one ladder-wide shape into roi_align so the second stage is the
+        # SAME program for every bucket (see layers.pad_feat_to_ladder)
+        feat = pad_feat_to_ladder(
+            feat, cfg.network.RCNN_FEAT_STRIDE, cfg.SHAPE_BUCKETS
+        )
+        trunk = self._roi_features(
+            feat, props.rois, fwd_only=True, valid_hw=im_info[:, :2]
+        )
         cls_logits, bbox_deltas = self.rcnn(trunk)
         b, r = images.shape[0], te.RPN_POST_NMS_TOP_N
         k = cfg.dataset.NUM_CLASSES

@@ -52,6 +52,7 @@ from mx_rcnn_tpu.ops.losses import (
 )
 from mx_rcnn_tpu.ops.nms import nms
 from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
+from mx_rcnn_tpu.ops.proposal import anchor_grid_mask
 from mx_rcnn_tpu.ops.roi_align import extract_roi_features_batched
 from mx_rcnn_tpu.ops.targets import assign_anchor, bbox_denorm_vectors, sample_rois
 
@@ -69,7 +70,13 @@ class FPNNeck(nn.Module):
     dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, feats: Tuple[jnp.ndarray, ...]) -> List[jnp.ndarray]:
+    def __call__(self, feats: Tuple[jnp.ndarray, ...],
+                 pad_mask=None) -> List[jnp.ndarray]:
+        # pad_mask (layers.make_pad_mask): re-zero bucket padding before
+        # the 3×3 smoothing convs — the laterals' biases repaint it
+        # nonzero, and the 1×1s / nearest upsample are spatially safe
+        # (valid fine cell i reads coarse cell ⌊i/2⌋, itself valid)
+        pm = pad_mask if pad_mask is not None else (lambda v: v)
         c2, c3, c4, c5 = feats
         laterals = [
             conv(self.channels, 1, 1, self.dtype, name=f"lateral{i + 2}",
@@ -88,7 +95,7 @@ class FPNNeck(nn.Module):
             outs.insert(0, target + up)
         return [
             conv(self.channels, 3, 1, self.dtype, name=f"post{i + 2}",
-                 use_bias=True)(p)
+                 use_bias=True)(pm(p))
             for i, p in enumerate(outs)
         ]
 
@@ -154,10 +161,11 @@ class FPNFasterRCNN(nn.Module):
             )
 
     # ----------------------------------------------------------- helpers
-    def _pyramid(self, images: jnp.ndarray) -> List[jnp.ndarray]:
-        """→ [P2, P3, P4, P5, P6]."""
-        c_feats = self.backbone(images)
-        ps = self.neck(c_feats)
+    def _pyramid(self, images: jnp.ndarray, pad_mask=None) -> List[jnp.ndarray]:
+        """→ [P2, P3, P4, P5, P6].  P6's 1×1-window pool mixes nothing
+        spatially, so it needs no mask of its own."""
+        c_feats = self.backbone(images, pad_mask=pad_mask)
+        ps = self.neck(c_feats, pad_mask=pad_mask)
         p6 = nn.max_pool(ps[-1], (1, 1), strides=(2, 2))
         return ps + [p6]
 
@@ -223,7 +231,8 @@ class FPNFasterRCNN(nn.Module):
         return out_boxes, out_scores, out_valid
 
     def _roi_features(
-        self, pyramid, rois: jnp.ndarray, fwd_only: bool = False
+        self, pyramid, rois: jnp.ndarray, fwd_only: bool = False,
+        valid_hw=None,
     ) -> jnp.ndarray:
         """Masked multi-level ROIAlign: (B, R, 4) → (B*R, D)."""
         net = self.cfg.network
@@ -233,6 +242,7 @@ class FPNFasterRCNN(nn.Module):
             feats = extract_roi_features_batched(
                 pyramid[li], rois, "roi_align", net.POOLED_SIZE,
                 1.0 / stride, net.ROI_SAMPLE_RATIO, fwd_only=fwd_only,
+                valid_hw=valid_hw,
             )                                            # (B, R, ph, pw, C)
             mask = (levels == li + 2)[..., None, None, None]
             contrib = jnp.where(mask, feats, 0.0)
@@ -365,9 +375,29 @@ class FPNFasterRCNN(nn.Module):
         te = cfg.TEST
         b = images.shape[0]
         k = cfg.dataset.NUM_CLASSES
-        pyramid = self._pyramid(images)
+        from mx_rcnn_tpu.models.layers import make_pad_mask
+
+        # serving invariance (see FasterRCNN.test_forward): mask bucket
+        # padding through the backbone/neck and on every pyramid level
+        # before the shared RPN's 3×3 conv.  Exactness additionally needs
+        # bucket dims divisible by the max feature stride (SHAPE_BUCKETS
+        # are), else the nearest-upsample index map varies per canvas.
+        pad_mask = make_pad_mask(im_info, (images.shape[1], images.shape[2]))
+        pyramid = [pad_mask(p) for p in self._pyramid(images, pad_mask)]
         rpn_logits, rpn_deltas, anchors, bounds = self._rpn_over_levels(pyramid)
         fg_scores = jax.nn.softmax(rpn_logits, axis=-1)[..., 1]
+        # padding-invariance (see FasterRCNN.test_forward): drop anchors
+        # whose grid cell lies in the bucket padding, per level
+        shapes = tuple((p.shape[1], p.shape[2]) for p in pyramid)
+        a_per_cell = len(cfg.network.ANCHOR_RATIOS) * len(
+            cfg.network.FPN_ANCHOR_SCALES
+        )
+        grid_ok = jax.vmap(
+            lambda info: anchor_grid_mask(
+                shapes, cfg.network.FPN_FEAT_STRIDES, a_per_cell, info
+            )
+        )(im_info)
+        fg_scores = jnp.where(grid_ok, fg_scores, _NEG_INF)
         n_levels = len(bounds) - 1
         pre_per_level = max(te.RPN_PRE_NMS_TOP_N // n_levels, 256)
         rois, roi_scores, roi_valid = jax.vmap(
@@ -377,7 +407,18 @@ class FPNFasterRCNN(nn.Module):
             )
         )(fg_scores, rpn_deltas, im_info)
 
-        trunk = self._roi_features(pyramid, rois, fwd_only=True)
+        # one ladder-wide shape per level into roi_align so the second
+        # stage is the SAME program for every bucket (see
+        # layers.pad_feat_to_ladder); P6 is RPN-only and stays unpadded
+        from mx_rcnn_tpu.models.layers import pad_feat_to_ladder
+
+        pyramid = [
+            pad_feat_to_ladder(p, s, cfg.SHAPE_BUCKETS)
+            for p, s in zip(pyramid[:4], cfg.network.FPN_FEAT_STRIDES[:4])
+        ] + pyramid[4:]
+        trunk = self._roi_features(
+            pyramid, rois, fwd_only=True, valid_hw=im_info[:, :2]
+        )
         cls_logits, bbox_deltas = self.rcnn(trunk)
         r = te.RPN_POST_NMS_TOP_N
         means, stds = bbox_denorm_vectors(cfg, k)
@@ -390,11 +431,14 @@ class FPNFasterRCNN(nn.Module):
             "bbox_deltas": bbox_deltas.reshape(b, r, 4 * k),
         }
         if cfg.network.USE_MASK:
-            out["mask_logits"] = self._mask_forward(pyramid, rois)
+            out["mask_logits"] = self._mask_forward(
+                pyramid, rois, valid_hw=im_info[:, :2]
+            )
         return out
 
     # ------------------------------------------------------------- mask head
-    def _mask_pooled(self, pyramid, rois, fwd_only: bool = False):
+    def _mask_pooled(self, pyramid, rois, fwd_only: bool = False,
+                     valid_hw=None):
         """(B, R, 4) → (B*R, 14, 14, C) mask-branch roi features."""
         net = self.cfg.network
         levels = roi_levels(rois)
@@ -403,6 +447,7 @@ class FPNFasterRCNN(nn.Module):
             feats = extract_roi_features_batched(
                 pyramid[li], rois, "roi_align", (14, 14),
                 1.0 / stride, net.ROI_SAMPLE_RATIO, fwd_only=fwd_only,
+                valid_hw=valid_hw,
             )
             mask = (levels == li + 2)[..., None, None, None]
             contrib = jnp.where(mask, feats, 0.0)
@@ -410,10 +455,12 @@ class FPNFasterRCNN(nn.Module):
         b, r = pooled.shape[0], pooled.shape[1]
         return pooled.reshape((b * r,) + pooled.shape[2:])
 
-    def _mask_forward(self, pyramid, rois):
+    def _mask_forward(self, pyramid, rois, valid_hw=None):
         """→ (B, R, 28, 28, K) per-class mask logits (test path)."""
         b, r = rois.shape[0], rois.shape[1]
-        logits = self.mask_head(self._mask_pooled(pyramid, rois, fwd_only=True))
+        logits = self.mask_head(
+            self._mask_pooled(pyramid, rois, fwd_only=True, valid_hw=valid_hw)
+        )
         return logits.reshape((b, r) + logits.shape[1:])
 
     def _mask_loss(self, pyramid, samples, gt_boxes, gt_valid, gt_masks=None):

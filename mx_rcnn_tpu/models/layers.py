@@ -76,6 +76,70 @@ def normalize_images(images: jnp.ndarray, im_info, cfg) -> jnp.ndarray:
     return out * mask
 
 
+def make_pad_mask(im_info, canvas_hw):
+    """→ ``fn(x)`` that zeroes feature cells sitting on bucket padding.
+
+    The serving/inference invariance tool: ``normalize_images`` zeroes
+    the padding at the input, but the first frozen BN maps those zeros to
+    its bias, so every subsequent k>1 conv at the valid-region edge would
+    read different neighbours on an exact-fit canvas (explicit zero
+    padding) than on a larger bucket (BN-propagated values) — detections
+    would depend on which bucket the image landed in.  Re-zeroing the pad
+    region *before each spatial op* restores the induction: edge convs
+    read zeros on every canvas, so the valid region is bitwise canvas-
+    independent (at fixed batch size; XLA's conv algorithm choice varies
+    with batch).
+
+    A cell (y, x) at feature stride s is valid iff ``s·y < h`` — the same
+    criterion as ``ops.proposal.anchor_grid_mask``.  The stride is
+    recovered from the canvas/feature ratio snapped to a power of two
+    (feature extents are ceil-of-halving chains, so the ratio is exact
+    for bucket-divisible levels and within [s/2, s] otherwise)."""
+    ch, cw = float(canvas_hw[0]), float(canvas_hw[1])
+
+    def snap(ratio: float) -> float:
+        import math
+
+        return float(2 ** round(math.log2(ratio))) if ratio > 1.0 else 1.0
+
+    def apply(x: jnp.ndarray) -> jnp.ndarray:
+        fh, fw = x.shape[1], x.shape[2]
+        sy, sx = snap(ch / fh), snap(cw / fw)
+        rows = jnp.arange(fh, dtype=jnp.float32) * sy
+        cols = jnp.arange(fw, dtype=jnp.float32) * sx
+        ok = (rows[None, :] < im_info[:, 0, None])[:, :, None] & (
+            cols[None, :] < im_info[:, 1, None]
+        )[:, None, :]
+        return x * ok[..., None].astype(x.dtype)
+
+    return apply
+
+
+def pad_feat_to_ladder(feat: jnp.ndarray, stride: int, shape_buckets):
+    """Zero-pad a (B, H, W, C) feature map to the bucket ladder's max
+    extent at this stride.
+
+    Companion to :func:`make_pad_mask` for EXACT cross-bucket serving
+    invariance: the masked feature values are canvas-independent, but the
+    roi-align → heads subgraph still compiles per canvas shape, and XLA's
+    shape-dependent scheduling can reassociate its reductions differently
+    (observed at ~1e-6 on box deltas under multi-device CPU).  Padding
+    the (masked) map to one ladder-wide shape gives that subgraph a
+    single HLO signature — identical inputs, identical program, identical
+    bits.  No-op when the canvas already reaches the ladder max (callers
+    outside the ladder keep their shapes)."""
+    if not shape_buckets:
+        return feat
+    th = max(feat.shape[1], max(-(-bh // stride) for bh, _ in shape_buckets))
+    tw = max(feat.shape[2], max(-(-bw // stride) for _, bw in shape_buckets))
+    if (th, tw) == (feat.shape[1], feat.shape[2]):
+        return feat
+    return jnp.pad(
+        feat,
+        ((0, 0), (0, th - feat.shape[1]), (0, tw - feat.shape[2]), (0, 0)),
+    )
+
+
 class _ConvKernel(nn.Module):
     """Parameter bank declaring an nn.Conv-compatible HWIO kernel.
 

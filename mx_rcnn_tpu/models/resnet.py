@@ -73,11 +73,14 @@ class Bottleneck(nn.Module):
     fold_bn: bool = False
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, pad_mask=None) -> jnp.ndarray:
+        pm = pad_mask if pad_mask is not None else (lambda v: v)
         cbn = make_conv_bn(self.fold_bn, self.dtype)
         y = cbn(x, self.filters, 1, self.stride, "conv1", "bn1")
         y = nn.relu(y)
-        y = cbn(y, self.filters, 3, 1, "conv2", "bn2")
+        # the only spatial (3×3) op in the unit: re-zero bucket padding
+        # first so edge cells read zeros on every canvas (layers.make_pad_mask)
+        y = cbn(pm(y), self.filters, 3, 1, "conv2", "bn2")
         y = nn.relu(y)
         y = cbn(y, self.filters * 4, 1, 1, "conv3", "bn3")
         residual = x
@@ -94,7 +97,7 @@ class ResNetStage(nn.Module):
     fold_bn: bool = False
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, pad_mask=None) -> jnp.ndarray:
         for i in range(self.num_units):
             x = Bottleneck(
                 self.filters,
@@ -102,7 +105,7 @@ class ResNetStage(nn.Module):
                 dtype=self.dtype,
                 fold_bn=self.fold_bn,
                 name=f"unit{i + 1}",
-            )(x)
+            )(x, pad_mask=pad_mask)
         return x
 
 
@@ -126,8 +129,9 @@ class ResNetBackbone(nn.Module):
     fold_bn: bool = False
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray):
+    def __call__(self, x: jnp.ndarray, pad_mask=None):
         blocks = _BLOCKS[self.depth]
+        pm = pad_mask if pad_mask is not None else (lambda v: v)
 
         def boundary(x, idx):
             return jax.lax.stop_gradient(x) if self.frozen_prefix == idx else x
@@ -135,7 +139,10 @@ class ResNetBackbone(nn.Module):
         x = x.astype(self.dtype)
         x = make_conv_bn(self.fold_bn, self.dtype)(x, 64, 7, 2, "conv0", "bn0")
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        # re-zero bucket padding before the 3×3 pool: relu output is ≥ 0,
+        # and every valid pool window holds ≥ 1 valid cell, so masked
+        # zeros can never win a max that real values would have won
+        x = nn.max_pool(pm(x), (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         x = boundary(x, 1)
 
         def stage(filters, n_units, stride, name):
@@ -144,12 +151,12 @@ class ResNetBackbone(nn.Module):
                 fold_bn=self.fold_bn, name=name,
             )
 
-        c2 = boundary(stage(64, blocks[0], 1, "stage1")(x), 2)
-        c3 = boundary(stage(128, blocks[1], 2, "stage2")(c2), 3)
-        c4 = boundary(stage(256, blocks[2], 2, "stage3")(c3), 4)
+        c2 = boundary(stage(64, blocks[0], 1, "stage1")(x, pad_mask), 2)
+        c3 = boundary(stage(128, blocks[1], 2, "stage2")(c2, pad_mask), 3)
+        c4 = boundary(stage(256, blocks[2], 2, "stage3")(c3, pad_mask), 4)
         if not self.return_pyramid:
             return c4
-        c5 = stage(512, blocks[3], 2, "stage4")(c4)
+        c5 = stage(512, blocks[3], 2, "stage4")(c4, pad_mask)
         return c2, c3, c4, c5
 
 

@@ -38,16 +38,20 @@ class VGGBackbone(nn.Module):
     frozen_prefix: int = 0
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, pad_mask=None) -> jnp.ndarray:
+        # pad_mask: re-zero bucket padding before every spatial op so the
+        # valid region is canvas-independent (the conv biases repaint the
+        # padding nonzero after each layer) — see layers.make_pad_mask
+        pm = pad_mask if pad_mask is not None else (lambda v: v)
         x = x.astype(self.dtype)
         for b, (n_convs, ch) in enumerate(_VGG16, start=1):
             for i in range(n_convs):
                 x = conv(
                     ch, 3, 1, self.dtype, name=f"conv{b}_{i + 1}", use_bias=True
-                )(x)
+                )(pm(x))
                 x = nn.relu(x)
             if b < 5:
-                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+                x = nn.max_pool(pm(x), (2, 2), strides=(2, 2))
             if b == self.frozen_prefix:
                 x = jax.lax.stop_gradient(x)
         return x

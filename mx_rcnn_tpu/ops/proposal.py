@@ -28,6 +28,30 @@ class Proposals(NamedTuple):
     valid: jnp.ndarray   # (POST_NMS,) bool
 
 
+def anchor_grid_mask(feat_shapes, strides, num_anchors, im_info) -> jnp.ndarray:
+    """One image: which anchor slots sit on image content — (N,) bool over
+    the concatenated per-level anchor table, row-major (y, x, anchor) per
+    level, matching ``shifted_anchors`` + the RPN head emission order.
+
+    An anchor whose grid cell lies in the bucket padding scores zero-image
+    features, so its fg score depends on the CANVAS rather than the image:
+    two buckets padding the same image would rank different pre-NMS top-k
+    sets and detections would drift with the bucket (the serving
+    padding-invariance bug).  Cell (y, x) is kept iff its top-left corner
+    ``(stride·y, stride·x)`` is inside the unpadded image — a canvas-
+    independent criterion, and every kept cell exists (with bit-identical
+    features) in every bucket the image fits.
+    """
+    h, w = im_info[0], im_info[1]
+    parts = []
+    for (fh, fw), stride in zip(feat_shapes, strides):
+        ys = jnp.arange(fh, dtype=jnp.float32) * stride < h
+        xs = jnp.arange(fw, dtype=jnp.float32) * stride < w
+        m = (ys[:, None] & xs[None, :]).reshape(-1)
+        parts.append(jnp.repeat(m, num_anchors))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
 def propose(
     fg_scores: jnp.ndarray,
     deltas: jnp.ndarray,

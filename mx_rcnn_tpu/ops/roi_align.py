@@ -25,7 +25,25 @@ import jax
 import jax.numpy as jnp
 
 
-def _bilinear_one_roi(feat, roi, pooled, sample_ratio, spatial_scale):
+def _feat_limits(feat_hw, valid_hw, spatial_scale):
+    """Per-axis sample-clamp limits: the canvas extent, or — when the true
+    pre-padding image size ``valid_hw`` is given — the number of feature
+    rows/cols that carry image content, ``ceil(h·scale)``.  Rows past that
+    are functions of the zero padding only, and (crucially) the clamp at
+    ``size − 1`` then lands at the same coordinate for every canvas the
+    image fits in, so the gather is bit-identical across shape buckets
+    (the serving padding-invariance guarantee; see SERVING.md)."""
+    if valid_hw is None:
+        return [(float(s), s) for s in feat_hw]
+    lims = []
+    for s, v in zip(feat_hw, (valid_hw[0], valid_hw[1])):
+        lim = jnp.minimum(jnp.ceil(v * spatial_scale), float(s))
+        lims.append((lim, lim.astype(jnp.int32)))
+    return lims
+
+
+def _bilinear_one_roi(feat, roi, pooled, sample_ratio, spatial_scale,
+                      valid_hw=None):
     """(H, W, C) × (4,) roi → (ph, pw, C) via average of bilinear samples."""
     hf, wf = feat.shape[0], feat.shape[1]
     ph, pw = pooled
@@ -41,15 +59,16 @@ def _bilinear_one_roi(feat, roi, pooled, sample_ratio, spatial_scale):
     gy = y1 + (jnp.arange(ph * s) + 0.5) / s * bin_h      # (ph*s,)
     gx = x1 + (jnp.arange(pw * s) + 0.5) / s * bin_w      # (pw*s,)
 
-    def axis_weights(g, size):
-        g = jnp.clip(g, 0.0, size - 1.0)
+    def axis_weights(g, lim_f, lim_i):
+        g = jnp.clip(g, 0.0, lim_f - 1.0)
         lo = jnp.floor(g).astype(jnp.int32)
-        hi = jnp.minimum(lo + 1, size - 1)
+        hi = jnp.minimum(lo + 1, lim_i - 1)
         whi = g - lo
         return lo, hi, 1.0 - whi, whi
 
-    ylo, yhi, wy0, wy1 = axis_weights(gy, hf)
-    xlo, xhi, wx0, wx1 = axis_weights(gx, wf)
+    (lh_f, lh_i), (lw_f, lw_i) = _feat_limits((hf, wf), valid_hw, spatial_scale)
+    ylo, yhi, wy0, wy1 = axis_weights(gy, lh_f, lh_i)
+    xlo, xhi, wx0, wx1 = axis_weights(gx, lw_f, lw_i)
 
     # two-stage separable gather: rows then columns
     rows0 = jnp.take(feat, ylo, axis=0)       # (ph*s, W, C)
@@ -72,8 +91,13 @@ def roi_align(
     spatial_scale: float = 1.0 / 16.0,
     sample_ratio: int = 2,
     chunk: int = 32,
+    valid_hw=None,
 ) -> jnp.ndarray:
-    """(H, W, C) feature + (R, 4) image-coord rois → (R, ph, pw, C)."""
+    """(H, W, C) feature + (R, 4) image-coord rois → (R, ph, pw, C).
+
+    ``valid_hw`` (2,) = the true pre-padding image (h, w): samples are
+    clamped to the valid feature extent instead of the canvas extent, so
+    the output is independent of which shape bucket padded the image."""
     r = rois.shape[0]
     pad = (-r) % chunk
     rois_p = jnp.concatenate([rois, jnp.zeros((pad, 4), rois.dtype)], axis=0)
@@ -81,14 +105,16 @@ def roi_align(
 
     def run_chunk(rs):
         return jax.vmap(
-            lambda roi: _bilinear_one_roi(feat, roi, pooled, sample_ratio, spatial_scale)
+            lambda roi: _bilinear_one_roi(
+                feat, roi, pooled, sample_ratio, spatial_scale, valid_hw
+            )
         )(rs)
 
     out = jax.lax.map(run_chunk, chunks)
     return out.reshape(-1, pooled[0], pooled[1], feat.shape[2])[:r]
 
 
-def _maxpool_one_roi(feat, roi, pooled, spatial_scale):
+def _maxpool_one_roi(feat, roi, pooled, spatial_scale, valid_hw=None):
     """Exact MXNet ROIPooling for one roi via masked-max contractions."""
     hf, wf = feat.shape[0], feat.shape[1]
     ph, pw = pooled
@@ -102,16 +128,18 @@ def _maxpool_one_roi(feat, roi, pooled, spatial_scale):
     bin_w = roi_w / pw
     bin_h = roi_h / ph
 
-    def bin_mask(start, bin_sz, nbins, size):
-        # mask[b, i]: cell i belongs to bin b (floor/ceil edges, clipped)
+    def bin_mask(start, bin_sz, nbins, size, lim):
+        # mask[b, i]: cell i belongs to bin b (floor/ceil edges, clipped
+        # to the valid feature extent so padded cells never win the max)
         b = jnp.arange(nbins, dtype=jnp.float32)
-        lo = jnp.clip(jnp.floor(start + b * bin_sz), 0, size)          # (nb,)
-        hi = jnp.clip(jnp.ceil(start + (b + 1.0) * bin_sz), 0, size)
+        lo = jnp.clip(jnp.floor(start + b * bin_sz), 0, lim)           # (nb,)
+        hi = jnp.clip(jnp.ceil(start + (b + 1.0) * bin_sz), 0, lim)
         i = jnp.arange(size, dtype=jnp.float32)
         return (i[None, :] >= lo[:, None]) & (i[None, :] < hi[:, None])
 
-    mh = bin_mask(y1, bin_h, ph, hf)   # (ph, H)
-    mw = bin_mask(x1, bin_w, pw, wf)   # (pw, W)
+    (lh, _), (lw, _) = _feat_limits((hf, wf), valid_hw, spatial_scale)
+    mh = bin_mask(y1, bin_h, ph, hf, lh)   # (ph, H)
+    mw = bin_mask(x1, bin_w, pw, wf, lw)   # (pw, W)
 
     neg = jnp.finfo(feat.dtype).min
     # max over h per bin row, then over w per bin col
@@ -128,6 +156,7 @@ def roi_pool(
     pooled: tuple = (7, 7),
     spatial_scale: float = 1.0 / 16.0,
     chunk: int = 4,
+    valid_hw=None,
 ) -> jnp.ndarray:
     """(H, W, C) feature + (R, 4) rois → (R, ph, pw, C), max-pooled.
 
@@ -148,7 +177,10 @@ def roi_pool(
 
     @jax.checkpoint
     def run_chunk(rs):
-        return jax.vmap(lambda roi: _maxpool_one_roi(feat, roi, pooled, spatial_scale))(rs)
+        return jax.vmap(
+            lambda roi: _maxpool_one_roi(feat, roi, pooled, spatial_scale,
+                                         valid_hw)
+        )(rs)
 
     out = jax.lax.map(run_chunk, chunks)
     return out.reshape(-1, pooled[0], pooled[1], feat.shape[2])[:r]
@@ -161,12 +193,14 @@ def extract_roi_features(
     pooled: tuple,
     spatial_scale: float,
     sample_ratio: int = 2,
+    valid_hw=None,
 ) -> jnp.ndarray:
     """Dispatch on config ROI_MODE ('roi_align' | 'roi_pool')."""
     if mode == "roi_align":
-        return roi_align(feat, rois, pooled, spatial_scale, sample_ratio)
+        return roi_align(feat, rois, pooled, spatial_scale, sample_ratio,
+                         valid_hw=valid_hw)
     if mode == "roi_pool":
-        return roi_pool(feat, rois, pooled, spatial_scale)
+        return roi_pool(feat, rois, pooled, spatial_scale, valid_hw=valid_hw)
     raise ValueError(f"unknown ROI_MODE {mode!r}")
 
 
@@ -178,6 +212,7 @@ def extract_roi_features_batched(
     spatial_scale: float,
     sample_ratio: int = 2,
     fwd_only: bool = False,
+    valid_hw=None,
 ) -> jnp.ndarray:
     """(B, H, W, C) × (B, R, 4) → (B, R, ph, pw, C).
 
@@ -191,6 +226,14 @@ def extract_roi_features_batched(
     play (real-TPU P2-shape timings, scripts/probe_stream_kernel.py:
     fwd 160 vs 121 ms, fwd+bwd 108 vs 326 ms), so forward-only graphs
     take the gather path there.
+
+    ``valid_hw`` (B, 2) = true pre-padding image sizes (``im_info[:, :2]``):
+    sample coordinates clamp to the valid feature extent instead of the
+    canvas, making the pooled features independent of the shape bucket
+    (the serving padding-invariance contract).  The Pallas kernels clamp
+    to the canvas, so a non-None ``valid_hw`` takes the jnp gather path
+    on every backend — inference-only callers pay a modest TPU perf cost
+    for exactness under bucketing.
     """
     from mx_rcnn_tpu.utils.platform import use_pallas
 
@@ -201,7 +244,7 @@ def extract_roi_features_batched(
     # outputs in scratch (ops/pallas/roi_align_stream.py)
     from mx_rcnn_tpu.ops.pallas.roi_align import fits_vmem
 
-    if mode == "roi_align" and use_pallas():
+    if mode == "roi_align" and valid_hw is None and use_pallas():
         if fits_vmem(
             feat.shape[1], feat.shape[2], feat.shape[3],
             pooled_max=max(pooled),
@@ -228,14 +271,28 @@ def extract_roi_features_batched(
         # Forward-only graphs (eval) have no residuals, so they fall
         # through to the batch-parallel vmap below: only one chunk's
         # live body exists at a time (~0.5 GB at flagship).
+        if valid_hw is None:
+            return jax.lax.map(
+                lambda fr: extract_roi_features(
+                    fr[0], fr[1], mode, pooled, spatial_scale, sample_ratio
+                ),
+                (feat, rois),
+            )
         return jax.lax.map(
             lambda fr: extract_roi_features(
-                fr[0], fr[1], mode, pooled, spatial_scale, sample_ratio
+                fr[0], fr[1], mode, pooled, spatial_scale, sample_ratio,
+                valid_hw=fr[2],
             ),
-            (feat, rois),
+            (feat, rois, valid_hw),
         )
+    if valid_hw is None:
+        return jax.vmap(
+            lambda f, r: extract_roi_features(
+                f, r, mode, pooled, spatial_scale, sample_ratio
+            )
+        )(feat, rois)
     return jax.vmap(
-        lambda f, r: extract_roi_features(
-            f, r, mode, pooled, spatial_scale, sample_ratio
+        lambda f, r, v: extract_roi_features(
+            f, r, mode, pooled, spatial_scale, sample_ratio, valid_hw=v
         )
-    )(feat, rois)
+    )(feat, rois, valid_hw)

@@ -1,0 +1,34 @@
+"""Online inference serving for the TPU Faster R-CNN.
+
+A request-level layer over the jitted test forward (ISSUE 2): images go
+through a fixed (H, W) bucket ladder (``buckets``), a deadline-aware
+dynamic micro-batcher (``batcher``), and one canonical predict path
+(``runner``) shared with ``core/tester.py`` and ``tools/demo.py``;
+``engine`` wires them into a threaded serving loop with per-request
+retry, and ``metrics``/``loadgen`` provide latency observability and a
+deterministic synthetic driver.  See SERVING.md for the architecture.
+"""
+
+from mx_rcnn_tpu.serve.batcher import DynamicBatcher, QueueFull, Request
+from mx_rcnn_tpu.serve.buckets import (
+    BucketLadder,
+    BucketOverflow,
+    CompileCache,
+)
+from mx_rcnn_tpu.serve.engine import DeadlineExceeded, ServingEngine
+from mx_rcnn_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+from mx_rcnn_tpu.serve.runner import ServeRunner
+
+__all__ = [
+    "BucketLadder",
+    "BucketOverflow",
+    "CompileCache",
+    "DeadlineExceeded",
+    "DynamicBatcher",
+    "LatencyHistogram",
+    "QueueFull",
+    "Request",
+    "ServeMetrics",
+    "ServeRunner",
+    "ServingEngine",
+]

@@ -1,0 +1,152 @@
+"""Deadline-aware dynamic micro-batcher.
+
+Requests arrive one at a time; the device wants full fixed-shape batches.
+The batcher holds a bounded per-bucket queue and releases a batch when
+either (a) some bucket has ``max_batch`` requests waiting — the happy
+saturated path — or (b) the oldest request has lingered ``max_linger``
+seconds, or (c) the oldest request's deadline is close enough that
+waiting any longer would blow it.  Linger is the single latency/
+throughput knob: 0 gives batch-of-1 dispatch latency, large values give
+full batches under light load at the cost of tail latency.
+
+Backpressure is a bounded total queue: ``submit`` raises
+:class:`QueueFull` instead of buffering unboundedly (the caller — an RPC
+edge in a real deployment — surfaces it as 429/503 and the client backs
+off).  This mirrors GuardedLoop's philosophy in ``core/resilience.py``:
+fail loudly at the boundary rather than degrade invisibly.
+
+Grouping is strictly per-bucket (one (H, W) canvas per device batch) so
+every released batch pads to a single jit signature; cross-bucket mixing
+would reintroduce the recompile problem the ladder exists to prevent.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class QueueFull(RuntimeError):
+    """Bounded queue is at capacity — reject the request (backpressure)."""
+
+
+@dataclass
+class Request:
+    """One prepared image waiting for a device slot.
+
+    ``image`` is already resized, (optionally) quantized, and padded to
+    ``bucket`` — preparation happens in the submitting thread (see
+    ``engine.submit``) so host preprocessing overlaps device execution
+    of earlier batches.
+    """
+
+    image: "np.ndarray"                  # (bH, bW, 3) bucket-padded
+    im_info: "np.ndarray"                # (3,) = (resized_h, resized_w, scale)
+    orig_hw: Tuple[int, int]             # original image size, for final clip
+    bucket: Tuple[int, int]
+    enqueue_t: float = 0.0               # time.monotonic at submit
+    deadline: Optional[float] = None     # absolute monotonic, or None
+    future: Future = field(default_factory=Future)
+    picked_t: float = 0.0                # set by next_batch (queue-wait metric)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline
+
+
+class DynamicBatcher:
+    """Thread-safe bucket-grouped micro-batcher (N producers, 1 consumer).
+
+    ``next_batch`` blocks until a batch is ready per the release rules
+    above, and returns ``None`` once closed and drained.
+    """
+
+    def __init__(
+        self,
+        max_batch: int,
+        max_linger: float = 0.005,
+        max_queue: int = 64,
+    ):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self.max_batch = int(max_batch)
+        self.max_linger = float(max_linger)
+        self.max_queue = int(max_queue)
+        self._queues: Dict[Tuple[int, int], deque] = {}
+        self._count = 0
+        self._closed = False
+        self._cond = threading.Condition()
+
+    # ------------------------------------------------------------- producers
+    def submit(self, req: Request) -> None:
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if self._count >= self.max_queue:
+                raise QueueFull(
+                    f"serving queue at capacity ({self.max_queue}) — "
+                    f"client should back off"
+                )
+            if not req.enqueue_t:
+                req.enqueue_t = time.monotonic()
+            self._queues.setdefault(req.bucket, deque()).append(req)
+            self._count += 1
+            self._cond.notify()
+
+    def pending(self) -> int:
+        with self._cond:
+            return self._count
+
+    def close(self) -> None:
+        """Stop accepting; wake the consumer so it can drain and exit."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -------------------------------------------------------------- consumer
+    def _oldest_bucket(self) -> Optional[Tuple[int, int]]:
+        best, best_t = None, None
+        for bucket, q in self._queues.items():
+            if q and (best_t is None or q[0].enqueue_t < best_t):
+                best, best_t = bucket, q[0].enqueue_t
+        return best
+
+    def _release_time(self, head: Request) -> float:
+        """Latest moment worth waiting for more traffic on head's bucket."""
+        cut = head.enqueue_t + self.max_linger
+        if head.deadline is not None:
+            # don't linger past the deadline itself; the engine budgets
+            # execution time via its own expiry check at pickup
+            cut = min(cut, head.deadline)
+        return cut
+
+    def next_batch(self, poll: float = 0.05) -> Optional[List[Request]]:
+        """Block for the next bucket-homogeneous batch (≤ ``max_batch``
+        requests, FIFO within the bucket).  ``None`` = closed + drained."""
+        with self._cond:
+            while True:
+                bucket = self._oldest_bucket()
+                if bucket is None:
+                    if self._closed:
+                        return None
+                    self._cond.wait(timeout=poll)
+                    continue
+                q = self._queues[bucket]
+                now = time.monotonic()
+                full = len(q) >= self.max_batch
+                if full or self._closed or now >= self._release_time(q[0]):
+                    n = min(len(q), self.max_batch)
+                    batch = [q.popleft() for _ in range(n)]
+                    self._count -= n
+                    for r in batch:
+                        r.picked_t = now
+                    self._cond.notify_all()
+                    return batch
+                # sleep until the head's release time, a new arrival, or
+                # close — whichever first
+                self._cond.wait(timeout=min(self._release_time(q[0]) - now, poll))

@@ -1,0 +1,190 @@
+"""Threaded serving loop: submit → batcher → device → per-request futures.
+
+Thread layout (why threads, not an async dispatch chain: the relay-
+attached TPU does not overlap stages of successive one-thread dispatches
+— measured in ``core/tester.py :: pipelined`` — but blocking predicts
+from separate threads DO overlap, the GIL dropping during relay I/O):
+
+  * N client threads: ``submit`` prepares the image (resize/quantize/
+    pad) in the CALLER's thread, so host preprocessing of the next
+    requests overlaps device execution of earlier batches, then enqueues
+    into the bounded batcher (``QueueFull`` → backpressure).
+  * 1 assembler thread: pulls bucket-homogeneous batches from the
+    batcher, fails requests whose deadline already passed (cheaper than
+    running them), pads to ``max_batch``, and hands the batch to…
+  * ``in_flight`` completion threads: blocking ``runner.run`` (wrapped
+    in PR 1's :class:`~mx_rcnn_tpu.core.resilience.RetryPolicy` — a
+    transient device/relay fault retries the whole batch
+    deterministically), then per-request detections + future resolution.
+    A semaphore keeps the assembler at most ``in_flight`` batches ahead,
+    so device-side queueing stays bounded too.
+
+Every request resolves exactly once: detections list, or
+:class:`DeadlineExceeded` / :class:`QueueFull` /
+:class:`~mx_rcnn_tpu.serve.buckets.BucketOverflow` / the predict error
+after retries are exhausted.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from mx_rcnn_tpu.core.resilience import RetryPolicy
+from mx_rcnn_tpu.serve.batcher import DynamicBatcher, QueueFull, Request
+from mx_rcnn_tpu.serve.metrics import ServeMetrics
+from mx_rcnn_tpu.serve.runner import ServeRunner
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before the device could run it."""
+
+
+class ServingEngine:
+    """Online inference front-end over a :class:`ServeRunner`."""
+
+    def __init__(
+        self,
+        runner: ServeRunner,
+        max_linger: float = 0.005,
+        max_queue: int = 64,
+        in_flight: int = 2,
+        retry: Optional[RetryPolicy] = None,
+    ):
+        self.runner = runner
+        self.batcher = DynamicBatcher(
+            runner.max_batch, max_linger=max_linger, max_queue=max_queue
+        )
+        self.metrics = ServeMetrics()
+        self.retry = retry if retry is not None else RetryPolicy(tries=3)
+        self._in_flight = max(1, int(in_flight))
+        self._sem = threading.Semaphore(self._in_flight)
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._assembler: Optional[threading.Thread] = None
+        self._started = False
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self, warmup: bool = True) -> "ServingEngine":
+        if self._started:
+            return self
+        if warmup:
+            self.runner.warmup()
+        self._pool = ThreadPoolExecutor(
+            max_workers=self._in_flight, thread_name_prefix="serve-complete"
+        )
+        self._assembler = threading.Thread(
+            target=self._assemble_loop, name="serve-assemble", daemon=True
+        )
+        self._started = True
+        self._assembler.start()
+        return self
+
+    def stop(self) -> None:
+        """Drain: stop accepting, finish queued work, join threads."""
+        if not self._started:
+            return
+        self.batcher.close()
+        self._assembler.join()
+        self._pool.shutdown(wait=True)
+        self._started = False
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- client
+    def submit(
+        self, im: np.ndarray, deadline_s: Optional[float] = None
+    ) -> Future:
+        """Enqueue one image; returns a Future resolving to the
+        per-class detections list.  Raises
+        :class:`~mx_rcnn_tpu.serve.buckets.BucketOverflow` (oversize) or
+        :class:`~mx_rcnn_tpu.serve.batcher.QueueFull` (backpressure)
+        synchronously — both count as ``rejected``."""
+        if not self._started:
+            raise RuntimeError("engine not started")
+        deadline = (
+            time.monotonic() + deadline_s if deadline_s is not None else None
+        )
+        try:
+            req = self.runner.make_request(im, deadline=deadline)
+            self.batcher.submit(req)
+        except Exception:
+            self.metrics.inc("rejected")
+            raise
+        self.metrics.inc("submitted")
+        self.metrics.record_queue_depth(self.batcher.pending())
+        return req.future
+
+    # ------------------------------------------------------------- device
+    def _assemble_loop(self) -> None:
+        while True:
+            batch_reqs = self.batcher.next_batch()
+            if batch_reqs is None:
+                return
+            now = time.monotonic()
+            live: List[Request] = []
+            for r in batch_reqs:
+                if r.expired(now):
+                    self.metrics.inc("expired")
+                    r.future.set_exception(
+                        DeadlineExceeded(
+                            f"deadline passed {now - r.deadline:.3f}s before "
+                            f"device pickup"
+                        )
+                    )
+                else:
+                    self.metrics.queue_wait.record(r.picked_t - r.enqueue_t)
+                    live.append(r)
+            self.metrics.record_queue_depth(self.batcher.pending())
+            if not live:
+                continue
+            batch = self.runner.assemble(live)
+            self._sem.acquire()  # at most in_flight batches on the device
+            self._pool.submit(self._complete, live, batch)
+
+    def _complete(
+        self, reqs: List[Request], batch: Dict[str, np.ndarray]
+    ) -> None:
+        try:
+            t0 = time.monotonic()
+
+            def attempt_run(attempt: int):
+                if attempt:
+                    self.metrics.inc("retried")
+                return self.runner.run(batch)
+
+            try:
+                out = self.retry.run(attempt_run)
+            except Exception as e:
+                self.metrics.inc("failed", len(reqs))
+                for r in reqs:
+                    r.future.set_exception(e)
+                return
+            done = time.monotonic()
+            self.metrics.service.record(done - t0)
+            self.metrics.record_batch(len(reqs), self.runner.max_batch)
+            for k, r in enumerate(reqs):
+                try:
+                    dets = self.runner.detections_for(
+                        out, batch, k, orig_hw=r.orig_hw
+                    )
+                except Exception as e:  # postprocess bug: fail this request
+                    self.metrics.inc("failed")
+                    r.future.set_exception(e)
+                    continue
+                self.metrics.inc("completed")
+                self.metrics.e2e.record(time.monotonic() - r.enqueue_t)
+                r.future.set_result(dets)
+        finally:
+            self._sem.release()
+
+    # ---------------------------------------------------------- reporting
+    def snapshot(self) -> Dict:
+        return self.metrics.snapshot(self.runner.compile_cache)

@@ -1,0 +1,303 @@
+"""The one canonical predict path: prepare → batch → forward → detections.
+
+Before this module, the repo had three copies of "raw head outputs →
+per-class detections" (``core/tester.py :: pred_eval.process_image``'s
+device and host branches, and ``tools/demo.py :: demo_net``); they have
+been collapsed onto :func:`detections_from_output` /
+:func:`cap_detections` here, and both callers now delegate.  The online
+engine (``serve/engine.py``) uses the same functions, so offline eval,
+the demo, and the serving endpoint are bit-identical per image by
+construction.
+
+:class:`ServeRunner` is the device-facing half: it owns the jitted
+:class:`~mx_rcnn_tpu.core.tester.Predictor` (with device postprocess
+when configured, and donated input buffers on accelerator backends),
+enforces the serving bucket ladder on the prepare path (oversize →
+:class:`~mx_rcnn_tpu.serve.buckets.BucketOverflow`, never a fresh
+compile), pads every batch to ``max_batch`` so each bucket has exactly
+ONE jit signature, and accounts signatures in a
+:class:`~mx_rcnn_tpu.serve.buckets.CompileCache` — ``warmup`` walks the
+ladder once, after which ``misses`` must stay 0.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.core.tester import Predictor, im_detect
+from mx_rcnn_tpu.data.image import normalize, pad_to_bucket, resize_im
+from mx_rcnn_tpu.native.hostops import nms_host
+from mx_rcnn_tpu.serve.batcher import Request
+from mx_rcnn_tpu.serve.buckets import BucketLadder, CompileCache
+
+ClsDets = List[Optional[np.ndarray]]  # [None, (n1, 5), ..., (nK-1, 5)]
+
+
+# --------------------------------------------------------------- detections
+def detections_from_output(
+    out: Dict[str, np.ndarray],
+    im_info_row: np.ndarray,
+    orig_hw: Tuple[float, float],
+    cfg: Config,
+    num_classes: int,
+    index: int = 0,
+    thresh: Optional[float] = None,
+):
+    """One image's forward outputs → per-class (n, 5) [x1 y1 x2 y2 score].
+
+    Handles both output flavors: the fused device-postprocess dict
+    (``det_boxes``/``det_scores``/``det_valid`` — decode, unscale, clip,
+    and per-class NMS already ran inside the jit) and raw head outputs
+    (host decode via :func:`~mx_rcnn_tpu.core.tester.im_detect`, then
+    per-class threshold + native NMS, the reference ``pred_eval`` inner
+    loop).  Returns ``(cls_dets, mask_probs)``; ``cls_dets[0]`` is None
+    (background), ``mask_probs`` is None unless the model emitted
+    ``mask_logits`` (host path only — mask models skip device postprocess).
+    """
+    te = cfg.TEST
+    thresh = te.SCORE_THRESH if thresh is None else thresh
+    cls_dets: ClsDets = [None] * num_classes
+    mask_probs: Optional[Dict[int, np.ndarray]] = None
+    if "det_boxes" in out:
+        for j in range(1, num_classes):
+            m = np.asarray(out["det_valid"][index][j - 1]).astype(bool)
+            b = np.asarray(out["det_boxes"][index][j - 1][m])
+            s = np.asarray(out["det_scores"][index][j - 1][m])
+            cls_dets[j] = np.hstack([b, s[:, None]]).astype(np.float32)
+    else:
+        det = im_detect(out, im_info_row, orig_hw, index=index)
+        scores, boxes = det["scores"], det["boxes"]
+        if "mask_probs" in det:
+            mask_probs = {}
+        for j in range(1, num_classes):
+            keep = np.where(scores[:, j] > thresh)[0]
+            cd = np.hstack(
+                [boxes[keep, j * 4 : (j + 1) * 4], scores[keep, j : j + 1]]
+            ).astype(np.float32)
+            keep_nms = nms_host(cd, te.NMS)
+            cls_dets[j] = cd[keep_nms]
+            if mask_probs is not None:
+                mask_probs[j] = det["mask_probs"][keep][keep_nms, :, :, j]
+    return cls_dets, mask_probs
+
+
+def cap_detections(
+    cls_dets: ClsDets,
+    max_per_image: int,
+    mask_probs: Optional[Dict[int, np.ndarray]] = None,
+):
+    """Cross-class per-image detection cap (COCO-style, reference
+    ``max_per_image``): keep the globally top-scoring ``max_per_image``
+    detections across classes.  No-op when ``max_per_image <= 0``."""
+    num_classes = len(cls_dets)
+    if max_per_image > 0:
+        all_scores = np.concatenate(
+            [cls_dets[j][:, 4] for j in range(1, num_classes)]
+        )
+        if len(all_scores) > max_per_image:
+            cut = np.sort(all_scores)[-max_per_image]
+            for j in range(1, num_classes):
+                keep = cls_dets[j][:, 4] >= cut
+                cls_dets[j] = cls_dets[j][keep]
+                if mask_probs is not None:
+                    mask_probs[j] = mask_probs[j][keep]
+    return cls_dets, mask_probs
+
+
+# ----------------------------------------------------------------- prepare
+def prepare_request(
+    im: np.ndarray,
+    cfg: Config,
+    ladder: BucketLadder,
+    deadline: Optional[float] = None,
+) -> Request:
+    """Original RGB image → bucket-padded :class:`Request`.
+
+    Same math as the offline ``data/image.py :: prepare_image`` (resize
+    to dataset SCALES, optional uint8 quantize per TEST.UINT8_TRANSFER,
+    zero-pad), but bucket choice goes through the serving ladder:
+    smallest fit, oversize REJECTED (:class:`BucketOverflow`) instead of
+    the offline largest-bucket fallback.  Runs in the submitting thread
+    so host preprocessing overlaps device execution of earlier batches.
+    """
+    im = np.asarray(im, np.float32)
+    orig_hw = (int(im.shape[0]), int(im.shape[1]))
+    target, max_size = cfg.dataset.SCALES[0]
+    im, scale = resize_im(im, target, max_size)
+    h, w = im.shape[:2]
+    bucket = ladder.select(h, w)  # raises BucketOverflow
+    if cfg.TEST.UINT8_TRANSFER:
+        im = np.clip(np.rint(im), 0, 255).astype(np.uint8)
+    else:
+        im = normalize(im, cfg.network.PIXEL_MEANS, cfg.network.PIXEL_STDS)
+    return Request(
+        image=pad_to_bucket(im, bucket),
+        im_info=np.array([h, w, scale], np.float32),
+        orig_hw=orig_hw,
+        bucket=bucket,
+        enqueue_t=time.monotonic(),
+        deadline=deadline,
+    )
+
+
+# ------------------------------------------------------------------ runner
+class ServeRunner:
+    """Device-facing predict path shared by the engine, bench, and tests."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        cfg: Config,
+        num_classes: Optional[int] = None,
+        ladder: Optional[BucketLadder] = None,
+        max_batch: int = 4,
+        donate: Optional[bool] = None,
+        device_postprocess: Optional[bool] = None,
+        deterministic: bool = False,
+    ):
+        self.cfg = cfg
+        self.num_classes = (
+            cfg.dataset.NUM_CLASSES if num_classes is None else num_classes
+        )
+        self.ladder = ladder if ladder is not None else BucketLadder(
+            cfg.SHAPE_BUCKETS
+        )
+        self.max_batch = int(max_batch)
+        self.uint8 = bool(cfg.TEST.UINT8_TRANSFER)
+        self.compile_cache = CompileCache()
+        if donate is None:
+            # donation only pays (and only works) on accelerator backends;
+            # the CPU runtime would log an unused-donation warning per jit
+            donate = jax.default_backend() in ("tpu", "axon")
+        post = None
+        if (
+            cfg.TEST.DEVICE_POSTPROCESS
+            if device_postprocess is None
+            else device_postprocess
+        ) and not cfg.network.USE_MASK:
+            from mx_rcnn_tpu.ops.postprocess import make_test_postprocess
+
+            post = make_test_postprocess(
+                cfg,
+                self.num_classes,
+                cfg.TEST.SCORE_THRESH,
+                max_out=cfg.TEST.DET_PER_CLASS,
+            )
+        # deterministic: shape-independent reduction order on CPU, making
+        # cross-bucket detections bitwise identical (Predictor docstring);
+        # default fast mode agrees to ~1e-5 px on box coordinates
+        self.predictor = Predictor(model, params, postprocess=post,
+                                   donate=donate, deterministic=deterministic)
+
+    # ---- request/batch plumbing
+    def make_request(
+        self, im: np.ndarray, deadline: Optional[float] = None
+    ) -> Request:
+        return prepare_request(im, self.cfg, self.ladder, deadline)
+
+    def assemble(self, requests: List[Request]) -> Dict[str, np.ndarray]:
+        """Bucket-homogeneous requests → device batch padded to
+        ``max_batch`` (pad slots replicate slot 0 so every bucket keeps a
+        single jit signature and pad work is never a fresh codepath)."""
+        n = len(requests)
+        if not 0 < n <= self.max_batch:
+            raise ValueError(f"batch of {n} vs max_batch={self.max_batch}")
+        bh, bw = requests[0].bucket
+        if any(r.bucket != (bh, bw) for r in requests):
+            raise ValueError("mixed buckets in one batch")
+        images = np.zeros(
+            (self.max_batch, bh, bw, 3), np.uint8 if self.uint8 else np.float32
+        )
+        im_info = np.zeros((self.max_batch, 3), np.float32)
+        orig_hw = np.zeros((self.max_batch, 2), np.float32)
+        for i, r in enumerate(requests):
+            images[i] = r.image
+            im_info[i] = r.im_info
+            orig_hw[i] = r.orig_hw
+        for i in range(n, self.max_batch):
+            images[i] = images[0]
+            im_info[i] = im_info[0]
+            orig_hw[i] = orig_hw[0]
+        return {"images": images, "im_info": im_info, "orig_hw": orig_hw}
+
+    def run(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Blocking forward; accounts the jit signature.  Blocking by
+        design: the engine overlaps batches with threads, which the
+        relay-attached TPU actually pipelines (see ``pipelined``)."""
+        self.compile_cache.record(
+            (batch["images"].shape, str(batch["images"].dtype))
+        )
+        return self.predictor.predict(batch)
+
+    def warmup(self) -> int:
+        """Precompile every ladder bucket at the (single) serving batch
+        size; returns the number of signatures compiled.  After this,
+        ``compile_cache.misses`` must not grow."""
+        for bh, bw in self.ladder:
+            req = Request(
+                image=np.zeros(
+                    (bh, bw, 3), np.uint8 if self.uint8 else np.float32
+                ),
+                im_info=np.array([bh, bw, 1.0], np.float32),
+                orig_hw=(bh, bw),
+                bucket=(bh, bw),
+            )
+            self.run(self.assemble([req]))
+        return self.compile_cache.misses
+
+    # ---- per-image postprocess
+    def detections_for(
+        self,
+        out: Dict[str, np.ndarray],
+        batch: Dict[str, np.ndarray],
+        index: int,
+        orig_hw: Optional[Tuple[float, float]] = None,
+        thresh: Optional[float] = None,
+    ) -> ClsDets:
+        if orig_hw is None:
+            orig_hw = tuple(batch["orig_hw"][index])
+        cls_dets, _ = detections_from_output(
+            out, batch["im_info"][index], orig_hw, self.cfg,
+            self.num_classes, index=index, thresh=thresh,
+        )
+        cls_dets, _ = cap_detections(cls_dets, self.cfg.TEST.MAX_PER_IMAGE)
+        return cls_dets
+
+    # ---- synchronous single image (demo path)
+    def detect(self, im: np.ndarray, thresh: Optional[float] = None) -> ClsDets:
+        req = self.make_request(im)
+        batch = self.assemble([req])
+        out = self.run(batch)
+        return self.detections_for(out, batch, 0, thresh=thresh)
+
+
+def detect_single(
+    predictor: Predictor,
+    im: np.ndarray,
+    cfg: Config,
+    num_classes: int,
+    thresh: Optional[float] = None,
+) -> ClsDets:
+    """One-shot detection with a caller-owned :class:`Predictor` (the
+    demo path: checkpoint already loaded, no engine).  Batch of 1, no
+    cross-class cap — identical semantics to the historical
+    ``demo_net`` inner loop, now routed through the shared
+    :func:`detections_from_output`."""
+    ladder = BucketLadder(cfg.SHAPE_BUCKETS)
+    req = prepare_request(im, cfg, ladder)
+    batch = {
+        "images": req.image[None],
+        "im_info": req.im_info[None],
+        "orig_hw": np.asarray([req.orig_hw], np.float32),
+    }
+    out = predictor.predict(batch)
+    cls_dets, _ = detections_from_output(
+        out, batch["im_info"][0], req.orig_hw, cfg, num_classes, thresh=thresh
+    )
+    return cls_dets

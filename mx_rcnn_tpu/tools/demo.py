@@ -17,11 +17,10 @@ import jax
 import numpy as np
 
 from mx_rcnn_tpu.config import Config, generate_config
-from mx_rcnn_tpu.core.tester import Predictor, im_detect
+from mx_rcnn_tpu.core.tester import Predictor
 from mx_rcnn_tpu.data.image import load_image
-from mx_rcnn_tpu.data.loader import make_batch
 from mx_rcnn_tpu.models import build_model
-from mx_rcnn_tpu.native.hostops import nms_host
+from mx_rcnn_tpu.serve.runner import detect_single
 from mx_rcnn_tpu.utils.visualize import draw_detections, save_image
 
 logger = logging.getLogger(__name__)
@@ -42,31 +41,18 @@ def demo_net(
     class_names=VOC_CLASSES,
     vis_thresh: float = 0.7,
 ):
-    """One image → {class_name: (n, 5) dets}.  ``im`` is RGB HWC uint8/f32."""
-    rec = {
-        "image": "demo://0",
-        "height": im.shape[0],
-        "width": im.shape[1],
-        "boxes": np.zeros((0, 4), np.float32),
-        "gt_classes": np.zeros((0,), np.int32),
-        "flipped": False,
-    }
-    from mx_rcnn_tpu.data.loader import _orientation_bucket
+    """One image → {class_name: (n, 5) dets}.  ``im`` is RGB HWC uint8/f32.
 
-    bucket = _orientation_bucket(rec, cfg.SHAPE_BUCKETS)
-    batch = make_batch([rec], cfg, bucket, images=[im])
-    out = predictor.predict(batch)
-    det = im_detect(out, batch["im_info"][0], (im.shape[0], im.shape[1]))
-    scores, boxes = det["scores"], det["boxes"]
+    Thin naming wrapper over the canonical predict path
+    (``serve/runner.py :: detect_single`` — the same decode/NMS the eval
+    loop and the serving engine use)."""
+    cls_dets = detect_single(
+        predictor, im, cfg, len(class_names), thresh=cfg.TEST.SCORE_THRESH
+    )
     dets_by_class = {}
     for j in range(1, len(class_names)):
-        keep = np.where(scores[:, j] > cfg.TEST.SCORE_THRESH)[0]
-        cls_dets = np.hstack(
-            [boxes[keep, j * 4 : (j + 1) * 4], scores[keep, j : j + 1]]
-        ).astype(np.float32)
-        cls_dets = cls_dets[nms_host(cls_dets, cfg.TEST.NMS)]
-        if (cls_dets[:, 4] >= vis_thresh).any():
-            dets_by_class[class_names[j]] = cls_dets
+        if (cls_dets[j][:, 4] >= vis_thresh).any():
+            dets_by_class[class_names[j]] = cls_dets[j]
     return dets_by_class
 
 

@@ -1,0 +1,136 @@
+"""Serving load test / endpoint smoke CLI.
+
+Spins up the full online stack (ladder → batcher → engine), drives it
+with the deterministic synthetic load generator, and prints the metrics
+snapshot: p50/p95/p99 latency, throughput, batch occupancy, and the
+compile counters that prove the bucket ladder held (misses ==
+len(ladder) after warmup, and not one more).
+
+Examples:
+  # CPU smoke at a tiny config (no checkpoint needed)
+  python -m mx_rcnn_tpu.tools.serve --small --requests 32
+
+  # real checkpoint at the flagship config
+  python -m mx_rcnn_tpu.tools.serve --network resnet --params final.pkl \
+      --requests 256 --concurrency 16 --out serve_report.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.serve.engine import ServingEngine
+from mx_rcnn_tpu.serve.loadgen import DEFAULT_SIZES, run_load
+from mx_rcnn_tpu.serve.runner import ServeRunner
+
+logger = logging.getLogger(__name__)
+
+
+def small_config(network: str):
+    """Tiny CPU-runnable config (integration-gate sizing): 128×128
+    buckets plus a 96×128 one so mixed-size load exercises a real
+    ladder."""
+    cfg = generate_config(network, "PascalVOC")
+    net_over = {"FIXED_PARAMS": ()}
+    if not cfg.network.USE_FPN:
+        net_over["ANCHOR_SCALES"] = (2, 4, 8)
+    if cfg.network.depth > 50 and cfg.network.name == "resnet":
+        net_over["depth"] = 50
+    return cfg.replace(
+        SHAPE_BUCKETS=((96, 128), (128, 128)),
+        network=dataclasses.replace(cfg.network, **net_over),
+        dataset=dataclasses.replace(
+            cfg.dataset, NUM_CLASSES=4, SCALES=((96, 128),)
+        ),
+        TEST=dataclasses.replace(
+            cfg.TEST,
+            RPN_PRE_NMS_TOP_N=200,
+            RPN_POST_NMS_TOP_N=32,
+            SCORE_THRESH=0.05,
+        ),
+    )
+
+
+def main():
+    from mx_rcnn_tpu.utils.platform import cli_bootstrap
+
+    cli_bootstrap()
+    p = argparse.ArgumentParser(description="Serving load test")
+    p.add_argument("--network", default="resnet50",
+                   choices=["vgg", "resnet", "resnet50", "resnet152",
+                            "resnet_fpn", "mask_resnet_fpn"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "PascalVOC0712", "coco"])
+    p.add_argument("--params", default=None, help="params pickle (random "
+                   "init when omitted — latency numbers are still valid)")
+    p.add_argument("--small", action="store_true",
+                   help="tiny config + small images for a CPU smoke run")
+    p.add_argument("--max_batch", type=int, default=4)
+    p.add_argument("--linger_ms", type=float, default=5.0)
+    p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--in_flight", type=int, default=2)
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--deadline_ms", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None, help="write the report JSON here")
+    args = p.parse_args()
+
+    if args.small:
+        cfg = small_config(args.network)
+        sizes = ((72, 96), (96, 128), (64, 80))
+    else:
+        cfg = generate_config(args.network, args.dataset)
+        sizes = DEFAULT_SIZES
+    model = build_model(cfg)
+    if args.params:
+        from mx_rcnn_tpu.utils.combine_model import load_params
+
+        params = load_params(args.params)
+    else:
+        h, w = cfg.SHAPE_BUCKETS[0]
+        params = model.init(
+            {"params": jax.random.key(0)},
+            np.zeros((1, h, w, 3), np.float32),
+            np.array([[h, w, 1.0]], np.float32),
+            train=False,
+        )["params"]
+        logger.warning("no --params — serving a random-init model")
+
+    runner = ServeRunner(model, params, cfg, max_batch=args.max_batch)
+    engine = ServingEngine(
+        runner,
+        max_linger=args.linger_ms / 1000.0,
+        max_queue=args.max_queue,
+        in_flight=args.in_flight,
+    )
+    logger.info("warming up %d bucket(s)...", len(runner.ladder))
+    with engine:
+        report = run_load(
+            engine,
+            num_requests=args.requests,
+            concurrency=args.concurrency,
+            sizes=sizes,
+            seed=args.seed,
+            deadline_s=(
+                args.deadline_ms / 1000.0
+                if args.deadline_ms is not None else None
+            ),
+        )
+    print(json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        logger.info("wrote %s", args.out)
+
+
+if __name__ == "__main__":
+    main()

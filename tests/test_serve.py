@@ -1,0 +1,216 @@
+"""Host-only serving-layer tests: ladder, compile cache, histograms,
+dynamic batcher.  No model, no jit — these run in milliseconds.
+
+(The device-facing half — runner/engine/padding invariance — lives in
+``test_serve_runner.py``; splitting keeps this file viable inside the
+tier-1 fast window.)
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.serve.batcher import DynamicBatcher, QueueFull, Request
+from mx_rcnn_tpu.serve.buckets import BucketLadder, BucketOverflow, CompileCache
+from mx_rcnn_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+
+
+def _req(bucket=(64, 64), deadline=None):
+    return Request(
+        image=np.zeros((1,), np.uint8),
+        im_info=np.array([1.0, 1.0, 1.0], np.float32),
+        orig_hw=(1, 1),
+        bucket=bucket,
+        deadline=deadline,
+    )
+
+
+# ------------------------------------------------------------------ ladder
+class TestBucketLadder:
+    def test_smallest_fit_and_exact_fit(self):
+        lad = BucketLadder([(128, 128), (96, 128), (64, 64)])
+        assert lad.select(64, 64) == (64, 64)       # exact fit
+        assert lad.select(65, 64) == (96, 128)      # next rung up
+        assert lad.select(90, 100) == (96, 128)
+        assert lad.select(128, 128) == (128, 128)
+
+    def test_orientation_buckets(self):
+        # both flagship orientations: fit is per-axis, not per-area
+        lad = BucketLadder([(608, 1024), (1024, 608)])
+        assert lad.select(600, 1000) == (608, 1024)
+        assert lad.select(1000, 600) == (1024, 608)
+
+    def test_oversize_rejected(self):
+        lad = BucketLadder([(128, 128)])
+        with pytest.raises(BucketOverflow):
+            lad.select(129, 10)
+        with pytest.raises(BucketOverflow):
+            lad.select(10, 129)
+        assert not lad.fits(129, 10)
+        assert lad.fits(128, 128)
+
+    def test_dedupe_sort_and_empty(self):
+        lad = BucketLadder([(96, 96), (64, 64), (96, 96)])
+        assert list(lad) == [(64, 64), (96, 96)]
+        assert len(lad) == 2
+        with pytest.raises(ValueError):
+            BucketLadder([])
+
+
+class TestCompileCache:
+    def test_hit_miss_accounting(self):
+        cc = CompileCache()
+        assert cc.record(((2, 64, 64, 3), "uint8")) is False  # miss=compile
+        assert cc.record(((2, 64, 64, 3), "uint8")) is True
+        assert cc.record(((2, 96, 96, 3), "uint8")) is False
+        assert (cc.hits, cc.misses) == (1, 2)
+        snap = cc.snapshot()
+        assert snap["hits"] == 1 and snap["misses"] == 2
+        assert len(snap["signatures"]) == 2
+
+    def test_thread_safety_single_compile_per_key(self):
+        cc = CompileCache()
+        misses = []
+
+        def hammer():
+            for _ in range(200):
+                if not cc.record("k"):
+                    misses.append(1)
+
+        ts = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(misses) == 1  # exactly one thread saw the compile
+        assert cc.hits + cc.misses == 800
+
+
+# --------------------------------------------------------------- histogram
+class TestLatencyHistogram:
+    def test_percentiles_within_bin_error(self):
+        h = LatencyHistogram()
+        vals = np.linspace(0.001, 0.1, 1000)  # 1..100 ms uniform
+        for v in vals:
+            h.record(v)
+        # geometric bins: ≤~10% relative error on percentile estimates
+        assert h.percentile(50) == pytest.approx(50.5, rel=0.12)
+        assert h.percentile(99) == pytest.approx(99.0, rel=0.12)
+        assert h.percentile(99) <= h.max_ms
+        assert h.mean_ms == pytest.approx(50.5, rel=0.01)  # exact sum
+
+    def test_empty_and_snapshot(self):
+        h = LatencyHistogram()
+        assert np.isnan(h.percentile(50))
+        assert h.snapshot()["count"] == 0
+        h.record(0.010)
+        s = h.snapshot()
+        assert s["count"] == 1
+        assert s["max_ms"] == pytest.approx(10.0)
+
+
+class TestServeMetrics:
+    def test_occupancy_and_counters(self):
+        m = ServeMetrics()
+        m.inc("submitted", 7)
+        m.record_batch(3, 4)
+        m.record_batch(4, 4)
+        assert m.occupancy == pytest.approx(7 / 8)
+        m.record_queue_depth(5)
+        m.record_queue_depth(2)
+        snap = m.snapshot()
+        assert snap["requests"]["submitted"] == 7
+        assert snap["batches"]["occupancy"] == pytest.approx(0.875)
+        assert snap["queue"] == {"depth": 2, "depth_max": 5}
+
+    def test_json_roundtrip_with_compile_cache(self):
+        import json
+
+        cc = CompileCache()
+        cc.record(((1, 64, 64, 3), "uint8"))
+        m = ServeMetrics()
+        m.e2e.record(0.005)
+        back = json.loads(m.to_json(cc))
+        assert back["compile"]["misses"] == 1
+        assert back["latency"]["e2e"]["count"] == 1
+
+
+# ----------------------------------------------------------------- batcher
+class TestDynamicBatcher:
+    def test_full_batch_releases_immediately(self):
+        b = DynamicBatcher(max_batch=2, max_linger=10.0)
+        b.submit(_req())
+        b.submit(_req())
+        t0 = time.monotonic()
+        batch = b.next_batch()
+        assert len(batch) == 2
+        assert time.monotonic() - t0 < 1.0  # did not linger
+        assert b.pending() == 0
+
+    def test_linger_releases_partial_batch(self):
+        b = DynamicBatcher(max_batch=4, max_linger=0.05)
+        b.submit(_req())
+        t0 = time.monotonic()
+        batch = b.next_batch()
+        dt = time.monotonic() - t0
+        assert len(batch) == 1
+        assert 0.03 <= dt < 2.0  # waited ≈ the linger, then gave up
+
+    def test_deadline_cuts_linger_short(self):
+        b = DynamicBatcher(max_batch=4, max_linger=5.0)
+        b.submit(_req(deadline=time.monotonic() + 0.05))
+        t0 = time.monotonic()
+        batch = b.next_batch()
+        assert len(batch) == 1
+        assert time.monotonic() - t0 < 2.0  # NOT the 5 s linger
+
+    def test_backpressure_queue_full(self):
+        b = DynamicBatcher(max_batch=4, max_linger=1.0, max_queue=2)
+        b.submit(_req())
+        b.submit(_req())
+        with pytest.raises(QueueFull):
+            b.submit(_req())
+        b.next_batch()  # drains both
+        b.submit(_req())  # capacity available again
+
+    def test_bucket_homogeneous_batches_fifo(self):
+        b = DynamicBatcher(max_batch=4, max_linger=0.0, max_queue=16)
+        b.submit(_req((64, 64)))
+        b.submit(_req((96, 96)))
+        b.submit(_req((64, 64)))
+        first = b.next_batch()
+        assert [r.bucket for r in first] == [(64, 64), (64, 64)]
+        second = b.next_batch()
+        assert [r.bucket for r in second] == [(96, 96)]
+
+    def test_close_drains_then_none(self):
+        b = DynamicBatcher(max_batch=4, max_linger=10.0)
+        b.submit(_req())
+        b.close()
+        assert len(b.next_batch()) == 1  # close overrides linger
+        assert b.next_batch() is None
+        with pytest.raises(RuntimeError):
+            b.submit(_req())
+
+    def test_producer_consumer_threads(self):
+        b = DynamicBatcher(max_batch=3, max_linger=0.01, max_queue=64)
+        got = []
+
+        def consume():
+            while True:
+                batch = b.next_batch()
+                if batch is None:
+                    return
+                got.extend(batch)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        for _ in range(10):
+            b.submit(_req())
+        time.sleep(0.05)
+        b.close()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert len(got) == 10
