@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast resilience bench serve integration-gate clean-native
+.PHONY: native test test-kernels test-fast resilience bench serve pipeline integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -62,6 +62,12 @@ bench-eval:
 # zero recompiles after warmup, as JSON lines + the artifact file
 serve:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve --out BENCH_serve_cpu.json
+
+# device-resident step pipeline bench (ISSUE 4): feed occupancy, fetch
+# stalls, K=1 byte-identical check on the CPU smoke config; emits JSON
+# lines + the BENCH_pipeline.json artifact
+pipeline:
+	JAX_PLATFORMS=cpu $(PY) bench.py --pipeline --out BENCH_pipeline.json
 
 # train→eval mAP gates on synthetic data, one per model family
 # (VERDICT r3 #7): C4 flagship shape, FPN, Mask (polygon gts + segm

@@ -214,6 +214,173 @@ def bench_serve(
     return records, report
 
 
+def _smoke_config(batch_images: int):
+    """Tiny CPU-runnable train config (96×96 bucket, shrunk RPN/ROI
+    budgets) — the same shrink the CLI smoke tests use, so the pipeline
+    bench measures loop mechanics, not model size."""
+    from mx_rcnn_tpu.config import generate_config
+
+    cfg = generate_config("resnet50", "PascalVOC")
+    return cfg.replace(
+        SHAPE_BUCKETS=((96, 96),),
+        TRAIN=dataclasses.replace(
+            cfg.TRAIN,
+            RPN_PRE_NMS_TOP_N=256,
+            RPN_POST_NMS_TOP_N=32,
+            BATCH_ROIS=16,
+            RPN_BATCH_SIZE=32,
+            BATCH_IMAGES=batch_images,
+        ),
+        dataset=dataclasses.replace(
+            cfg.dataset, SCALES=((96, 96),), MAX_GT_BOXES=8
+        ),
+    )
+
+
+def _pipeline_records(report: dict) -> list:
+    """Pipeline report → the JSON-line records (pure; the bench schema
+    test builds a synthetic report and asserts the feed-occupancy and
+    fetch-stall fields are present without running the model)."""
+    feed = report["feed"]
+    loop = report["loop"]
+    def rec(metric, value, unit):
+        return {"metric": metric, "value": value, "unit": unit,
+                "vs_baseline": None}
+    return [
+        rec("pipeline_feed_occupancy", feed["occupancy"], "fraction"),
+        rec("pipeline_feed_starved_steps",
+            feed["feed_starved_after_first"], "steps"),
+        rec("pipeline_min_staged_ahead", report["min_staged_ahead"],
+            "batches"),
+        rec("pipeline_aux_fetches", loop["fetches"], "fetches"),
+        rec("pipeline_fetch_stalls", loop["fetch_stalls"], "stalls"),
+        rec("pipeline_fetch_stall_ms", loop["fetch_stall_ms"], "ms"),
+        rec("pipeline_interflush_blocking_fetches",
+            report["interflush_blocking_fetches"], "fetches"),
+        rec("pipeline_k1_byte_identical",
+            int(report["k1_byte_identical"]), "bool"),
+        rec("pipeline_train_imgs_per_sec_cpu_smoke",
+            report["imgs_per_sec"], "imgs/sec"),
+    ]
+
+
+def bench_pipeline(
+    steps: int, aux_interval: int, feed_depth: int, batch_images: int
+) -> tuple:
+    """Measure the device-resident step pipeline on the CPU smoke config.
+
+    Three runs over the identical (seeded) batch stream with ONE shared
+    compiled step: a synchronous GuardedLoop baseline, a PipelinedLoop
+    at K=1 (byte-identical check: donation + feed must not perturb a
+    single bit of the final state), and the measured PipelinedLoop at
+    K=``aux_interval`` behind a depth-``feed_depth`` DeviceFeed.
+    → (records, report).  CPU smoke numbers prove the MECHANISM (overlap
+    counters, zero inter-flush fetches); device wins ride the next TPU
+    round (ROOFLINE "host gap, revisited").
+    """
+    import jax
+
+    from mx_rcnn_tpu.core.pipeline import DeviceFeed, PipelinedLoop
+    from mx_rcnn_tpu.core.resilience import GuardedLoop
+    from mx_rcnn_tpu.core.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from mx_rcnn_tpu.data.loader import TrainLoader
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.utils.load_data import load_gt_roidb
+
+    cfg = _smoke_config(batch_images)
+    _, roidb = load_gt_roidb(
+        cfg, None, flip=False, synthetic_size=max(8, 4 * batch_images)
+    )
+    model = build_model(cfg)
+    h, w = cfg.SHAPE_BUCKETS[0]
+    params = model.init(
+        {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+        images=np.zeros((1, h, w, 3), np.float32),
+        im_info=np.array([[h, w, 1.0]], np.float32),
+        gt_boxes=np.zeros((1, cfg.dataset.MAX_GT_BOXES, 5), np.float32),
+        gt_valid=np.zeros((1, cfg.dataset.MAX_GT_BOXES), bool),
+        train=True,
+    )["params"]
+    tx = make_optimizer(cfg, lambda s: cfg.TRAIN.LEARNING_RATE)
+    # deterministic: the K=1 byte-identical check compares two runs
+    # bitwise, which the default CPU thunk runtime breaks on its own —
+    # it reassociates reductions across threads, so even the sync
+    # baseline is not repeatable against itself (~1e-7/run drift)
+    step_fn = make_train_step(model, tx, donate=True, deterministic=True)
+    host_params = jax.device_get(params)
+
+    def batch_stream(n):
+        loader = TrainLoader(
+            roidb, cfg, batch_images, shuffle=True, seed=0
+        )
+        got = 0
+        while got < n:
+            for b in loader:
+                yield b
+                got += 1
+                if got >= n:
+                    return
+
+    def state_bytes(state):
+        return b"".join(
+            np.asarray(x).tobytes()
+            for x in jax.tree_util.tree_leaves(jax.device_get(state))
+        )
+
+    rng = jax.random.key(0)
+
+    def run_sync(n):
+        state = create_train_state(host_params, tx)
+        guard = GuardedLoop(step_fn)
+        for b in batch_stream(n):
+            state, _aux, _ok = guard.step(state, b, rng)
+        return state_bytes(state)
+
+    def run_pipelined(n, k):
+        state = create_train_state(host_params, tx)
+        loop = PipelinedLoop(step_fn, aux_interval=k)
+        feed = DeviceFeed(batch_stream(n), depth=feed_depth)
+        t0 = time.perf_counter()
+        try:
+            for b in feed:
+                state, _ready, _ok = loop.step(state, b, rng)
+        finally:
+            stats = feed.stats()
+            feed.close()
+        state, _ready, _ok = loop.flush(state)
+        dt = time.perf_counter() - t0
+        return state_bytes(state), stats, loop, dt
+
+    sync_bytes = run_sync(steps)  # also: compile warmup for all runs
+    k1_bytes, _, _, _ = run_pipelined(steps, 1)
+    _, feed_stats, loop_k, dt = run_pipelined(steps, aux_interval)
+
+    loop_stats = loop_k.stats()
+    report = {
+        "steps": steps,
+        "batch_images": batch_images,
+        "aux_interval": aux_interval,
+        "feed_depth": feed_depth,
+        "feed": feed_stats,
+        "loop": loop_stats,
+        # every non-boundary step had >= 1 batch staged ahead iff no
+        # post-first get ever blocked on the worker
+        "min_staged_ahead": int(feed_stats["feed_starved_after_first"] == 0),
+        # the sink only fetches inside flush(): any excess fetch over the
+        # flush count would be a blocking fetch between flush points
+        "interflush_blocking_fetches": max(
+            0, loop_stats["fetches"] - loop_stats["flushes"]
+        ),
+        "k1_byte_identical": k1_bytes == sync_bytes,
+        "imgs_per_sec": round(batch_images * steps / dt, 3),
+    }
+    return _pipeline_records(report), report
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -246,6 +413,18 @@ def main():
         help="serve at the full config (default: tiny CPU-runnable one)",
     )
     ap.add_argument(
+        "--pipeline", action="store_true",
+        help="bench the device-resident step pipeline (feed occupancy, "
+             "fetch stalls, K=1 byte-identical check) on the CPU smoke "
+             "config",
+    )
+    ap.add_argument("--pipeline_steps", type=int, default=16)
+    ap.add_argument("--aux_interval", type=int, default=4,
+                    help="K: train aux fetched every K steps")
+    ap.add_argument("--feed_depth", type=int, default=2,
+                    help="device-feed double-buffer depth")
+    ap.add_argument("--pipeline_batch", type=int, default=2)
+    ap.add_argument(
         "--out", default=None,
         help="also write the records as a JSON array artifact",
     )
@@ -254,6 +433,18 @@ def main():
     from mx_rcnn_tpu.utils.platform import enable_compile_cache
 
     enable_compile_cache()
+
+    if args.pipeline:
+        records, report = bench_pipeline(
+            args.pipeline_steps, args.aux_interval, args.feed_depth,
+            args.pipeline_batch,
+        )
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
 
     if args.serve:
         network = "resnet50" if args.network == "resnet" else args.network

@@ -17,10 +17,11 @@ import numpy as np
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.core.metrics import MetricTracker, Speedometer
+from mx_rcnn_tpu.core.pipeline import DeviceFeed, PipelinedLoop
 from mx_rcnn_tpu.core.resilience import (
     DivergencePolicy,
-    GuardedLoop,
     StepWatchdog,
+    host_copy,
 )
 from mx_rcnn_tpu.core.train import (
     create_train_state,
@@ -41,10 +42,13 @@ def merge_params(init_params: Dict, donor: Dict) -> Dict:
     (``models/stage_models.py``), so transferring e.g. an RPNOnly
     checkpoint into a FastRCNN init is a dict update on the intersection.
     """
-    out = dict(jax.device_get(init_params))
+    # host_copy, not device_get: a view of buffers a later donating step
+    # reclaims would silently corrupt the merged tree (CPU device_get is
+    # zero-copy)
+    out = dict(host_copy(init_params))
     for k in out:
         if k in donor:
-            out[k] = jax.device_get(donor[k])
+            out[k] = host_copy(donor[k])
     return out
 
 
@@ -62,6 +66,8 @@ def fit(
     max_steps: int = 0,
     guard_policy: Optional[DivergencePolicy] = None,
     step_timeout: float = 0.0,
+    aux_interval: int = 1,
+    feed_depth: int = 2,
 ) -> Dict:
     """Train ``model`` on ``roidb`` and return the final params.
 
@@ -69,13 +75,19 @@ def fit(
     (pretrained backbone / previous stage).  ``fixed_params``: freeze-set
     override (FIXED_PARAMS_SHARED for stage-2).
 
-    Every step runs under a :class:`GuardedLoop` (``guard_policy``
+    Every step runs under a :class:`PipelinedLoop` (``guard_policy``
     overrides the divergence defaults): a NaN/Inf or spiking loss is
     retried with LR backoff, then rolled back and the poison batch
     skipped, instead of the pre-resilience behavior of finishing the
     whole run and *warning* about the destroyed loss at the end.
     ``step_timeout`` > 0 additionally arms a watchdog that aborts a hung
     step with :data:`~mx_rcnn_tpu.core.resilience.WATCHDOG_EXIT_CODE`.
+
+    Batches reach the device through a :class:`DeviceFeed` of depth
+    ``feed_depth`` (batch N+1's transfer overlaps step N) and the train
+    step donates its input state.  ``aux_interval`` > 1 defers the aux
+    fetch K steps (flushed at epoch end); the default 1 keeps the
+    per-step check byte-identical to the synchronous loop.
     """
     loader = TrainLoader(
         roidb, cfg, cfg.TRAIN.BATCH_IMAGES,
@@ -105,32 +117,50 @@ def fit(
         cfg, make_lr_schedule(cfg, steps_per_epoch), fixed_params=fixed_params
     )
     state = create_train_state(params, tx)
-    step_fn = make_train_step(model, tx, donate=False)
+    # donation unified with the end2end/mesh entry points: rollback
+    # re-places from the guard's host snapshot, never a donated buffer
+    step_fn = make_train_step(model, tx, donate=True)
     rng = jax.random.key(seed + 123)
 
     tracker = MetricTracker()
     speedo = Speedometer(cfg.TRAIN.BATCH_IMAGES, frequent)
     watchdog = StepWatchdog(step_timeout) if step_timeout > 0 else None
-    guard = GuardedLoop(step_fn, policy=guard_policy, watchdog=watchdog)
+    pipeline = PipelinedLoop(
+        step_fn, policy=guard_policy, watchdog=watchdog,
+        aux_interval=aux_interval,
+    )
+
+    def deliver(ready):
+        for _idx, aux in ready:
+            tracker.update({k: float(v) for k, v in aux.items()})
+
     total_steps = 0
     for epoch in range(epochs):
-        for batch in loader:
-            state, aux, ok = guard.step(state, batch, rng)
-            if ok:
-                tracker.update({k: float(v) for k, v in aux.items()})
-            total_steps += 1
-            speedo(epoch, total_steps, tracker)
-            if max_steps and total_steps >= max_steps:
-                break
+        feed = DeviceFeed(iter(loader), depth=feed_depth)
+        try:
+            for batch in feed:
+                state, ready, _ok = pipeline.step(state, batch, rng)
+                deliver(ready)
+                total_steps += 1
+                speedo(epoch, total_steps, tracker)
+                if max_steps and total_steps >= max_steps:
+                    break
+        finally:
+            feed.close()
+        state, ready, _ok = pipeline.flush(state)
+        deliver(ready)
         if max_steps and total_steps >= max_steps:
             break
-    last_loss = guard.last_loss if total_steps else float("nan")
+    last_loss = pipeline.last_loss if total_steps else float("nan")
     logger.info("fit done: %d steps, last loss %.4f", total_steps, last_loss)
-    if guard.skipped_batches:
+    if pipeline.skipped_batches:
         logger.warning(
             "fit skipped %d poison batch(es) after rollback "
-            "(%d retried steps)", guard.skipped_batches, guard.retried_steps
+            "(%d retried steps)",
+            pipeline.skipped_batches, pipeline.retried_steps,
         )
     if total_steps and not np.isfinite(last_loss):
         logger.warning("fit finished with non-finite loss")
-    return jax.device_get(state.params)
+    # owning copy: the caller's tree must survive this state's buffers
+    # (the next alternate stage donates its own state into reused memory)
+    return host_copy(state.params)

@@ -1,0 +1,563 @@
+"""Device-resident step pipeline: double-buffered host→device feed,
+K-late aux fetch, and a guarded loop that keeps training device-bound.
+
+ROOFLINE.md reconciles the flagship step to 103.9 ms device-busy plus
+**~5.4 ms/step of un-hidden host work** — aux fetch, loader hand-off,
+dispatch residue.  The reference paper hid that slice behind MXNet's
+async dependency engine (``rcnn/core/loader.py``'s prefetching
+``AnchorLoader`` + KVStore); our loader stopped at host-side numpy
+prefetch and every step blocked on a device→host ``aux`` fetch.  This
+module closes the gap with three cooperating pieces:
+
+- :class:`DeviceFeed` — extends the host prefetcher with a second,
+  device-facing stage: a worker thread runs ``place_fn`` (sharding- and
+  layout-aware ``jax.device_put``) on batch N+1 while the consumer's
+  step N executes, keeping ``depth`` batches staged on device.  JAX
+  transfers are async, so the H2D copy itself overlaps device compute;
+  the staged queue keeps the *dispatch* path free of host assembly too.
+  Occupancy counters (staged hits, feed-starved gets) turn "is the feed
+  keeping up" into a measured number (``bench.py --pipeline``).
+- :class:`AsyncAuxSink` — the non-blocking metrics half: train steps
+  return ``aux`` as device arrays and the sink fetches them in one
+  batched ``device_get`` per flush instead of one blocking fetch per
+  step, counting fetches and fetch *stalls* (a flush that had to wait
+  on device results).
+- :class:`PipelinedLoop` — :class:`~mx_rcnn_tpu.core.resilience
+  .GuardedLoop` semantics with the aux check deferred ``aux_interval``
+  steps: the NaN/spike guard still fires, merely K steps late, against
+  the retained window snapshot.  On a flagged step the loop rolls back,
+  *replays* the verified prefix (deterministic: the sampling rng folds
+  ``state.step``, which the rollback restores), retries the poison step
+  synchronously through the guard (LR backoff → skip, budgets intact),
+  and re-runs the suffix that had executed on the poisoned lineage.
+  ``aux_interval=1`` delegates to the guard directly and is
+  byte-identical to the synchronous path (pinned by
+  ``tests/test_pipeline.py``).
+
+Placement is unified across entry points through :func:`make_place_fn`:
+single chip → ``jax.device_put`` (optionally into the compiled step's
+input layouts, killing the input relayout copy), DP mesh →
+``parallel/mesh.py :: shard_batch``, multi-host →
+``parallel/distributed.py :: globalize_batch``.  ``core/fit.py``,
+``tools/train_end2end.py``, ``core/tester.py :: pipelined``,
+``tools/bench_eval.py`` and ``serve/runner.py`` all draw device-feed
+from here.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.core.resilience import (
+    DivergencePolicy,
+    GuardedLoop,
+    StepWatchdog,
+    host_copy,
+)
+from mx_rcnn_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
+
+
+# ----------------------------------------------------------------- placement
+def make_place_fn(mesh=None, layouts=None) -> Callable[[Any], Any]:
+    """One placement path for every feed consumer.
+
+    ``mesh`` None → plain ``jax.device_put`` (into ``layouts`` — a pytree
+    of ``jax.experimental.layout.Layout`` matching the batch — when
+    given, so the transfer lands in the layout the compiled step expects
+    and XLA inserts no input relayout copy).  With a mesh: single
+    process shards the leading axis (``shard_batch``); multi-process
+    assembles the global array view (``globalize_batch``).
+    """
+    import jax
+
+    if mesh is not None:
+        from mx_rcnn_tpu.parallel import distributed
+        from mx_rcnn_tpu.parallel.mesh import shard_batch
+
+        if jax.process_count() > 1:
+            return lambda batch: distributed.globalize_batch(batch, mesh)
+        return lambda batch: shard_batch(batch, mesh)
+    if layouts is not None:
+        return lambda batch: jax.device_put(batch, layouts)
+    return jax.device_put
+
+
+def input_layouts_for(jitted, args, argnum: int = 1):
+    """The compiled input layouts of ``jitted``'s ``argnum``-th argument.
+
+    ``args`` may be real arrays or ``jax.ShapeDtypeStruct`` trees (no
+    data needed — lowering is abstract).  Feeding ``device_put`` these
+    layouts makes the host→device transfer deliver device-native tiling
+    directly, so XLA stops inserting the input relayout copy that the
+    ROOFLINE layout-copy row charges ~1.1 ms/step to.  Returns None when
+    the runtime doesn't expose layouts (older jax) or lowering fails —
+    callers fall back to plain ``device_put``.
+    """
+    try:
+        compiled = jitted.lower(*args).compile()
+        in_args, _kwargs = compiled.input_layouts
+        return in_args[argnum]
+    except Exception as e:  # noqa: BLE001 — layout feed is best-effort
+        logger.debug("input_layouts_for: falling back to plain put (%r)", e)
+        return None
+
+
+def shape_structs(tree):
+    """Pytree of arrays → matching ``jax.ShapeDtypeStruct`` tree (for
+    abstract lowering in :func:`input_layouts_for`)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), tree
+    )
+
+
+# ---------------------------------------------------------------- DeviceFeed
+class DeviceFeed:
+    """Double-buffered host→device staging iterator.
+
+    A daemon worker drains ``source`` and runs ``place_fn`` on each item
+    ``depth`` items ahead of the consumer, so batch N+1's H2D transfer
+    (async under JAX) overlaps batch N's step.  Composes with the
+    loader's own host prefetch thread: decode/assembly → host queue →
+    this worker (placement) → staged queue → consumer.
+
+    Lifecycle: sentinel-based shutdown — :meth:`close` (or the context
+    manager / GC) wakes the worker, drains staged references, joins the
+    thread, and closes the source; worker exceptions re-raise in the
+    consumer (a swallowed placement error would silently truncate an
+    epoch).  Counters make feed health measurable:
+
+    - ``fed`` — items handed to the consumer;
+    - ``staged_hits`` — gets served from an already-staged item (the
+      next batch was on device before the previous step retired);
+    - ``feed_starved`` / ``feed_starved_after_first`` — gets that had to
+      wait on the worker (the first get always waits: nothing has been
+      staged yet when the consumer arrives instantly).
+    """
+
+    def __init__(
+        self,
+        source,
+        place_fn: Optional[Callable[[Any], Any]] = None,
+        depth: int = 2,
+        name: str = "device-feed",
+    ):
+        import jax
+
+        self._source = source
+        self._place = place_fn if place_fn is not None else jax.device_put
+        self.depth = max(1, int(depth))
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._closed = threading.Event()
+        self._done = False
+        self.fed = 0
+        self.staged_hits = 0
+        self.feed_starved = 0
+        self.feed_starved_after_first = 0
+        self._thread = threading.Thread(
+            target=self._worker, name=name, daemon=True
+        )
+        self._thread.start()
+
+    # -- worker side
+    def _put(self, msg) -> bool:
+        """Bounded put that gives up once the consumer is gone (same
+        discipline as the loader's prefetch thread — a plain ``put``
+        would park the worker forever on abandonment, leaking the thread
+        plus ``depth`` staged batches)."""
+        while not self._closed.is_set():
+            try:
+                self._q.put(msg, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            for item in self._source:
+                staged = self._place(item)
+                if not self._put(("item", staged)):
+                    return
+            self._put(("stop", None))
+        except BaseException as e:  # noqa: BLE001 — handed to the consumer
+            self._put(("err", e))
+
+    # -- consumer side
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed.is_set() or self._done:
+            raise StopIteration
+        try:
+            kind, payload = self._q.get_nowait()
+            staged = True
+        except queue.Empty:
+            staged = False
+            while True:
+                try:
+                    kind, payload = self._q.get(timeout=0.2)
+                    break
+                except queue.Empty:
+                    if self._closed.is_set():
+                        raise StopIteration from None
+        if kind == "stop":
+            self._done = True
+            raise StopIteration
+        if kind == "err":
+            self._done = True
+            raise payload
+        if staged:
+            self.staged_hits += 1
+        else:
+            self.feed_starved += 1
+            if self.fed > 0:
+                self.feed_starved_after_first += 1
+        self.fed += 1
+        return payload
+
+    def wait_staged(self, n: int = 1, timeout: float = 10.0) -> bool:
+        """Block until ≥ ``n`` items are staged (or the stream ended /
+        timed out).  Lets a consumer give the feed a deterministic head
+        start; tests use it to make overlap assertions timing-free."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._q.qsize() >= n or self._done or not self._thread.is_alive():
+                return self._q.qsize() >= n
+            time.sleep(0.005)
+        return False
+
+    def stats(self) -> Dict[str, Any]:
+        fed = max(self.fed, 1)
+        return {
+            "fed": self.fed,
+            "depth": self.depth,
+            "staged_hits": self.staged_hits,
+            "feed_starved": self.feed_starved,
+            "feed_starved_after_first": self.feed_starved_after_first,
+            "occupancy": round(self.staged_hits / fed, 4),
+        }
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Idempotent shutdown: signal the worker, drain staged
+        references (frees pinned device buffers), join, close source."""
+        self._closed.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+        close = getattr(self._source, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 — best-effort source close
+                pass
+
+    def __enter__(self) -> "DeviceFeed":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # abandoned without close(): still reclaim
+        try:
+            self.close(timeout=0.2)
+        except Exception:  # noqa: BLE001 — interpreter may be tearing down
+            pass
+
+
+# -------------------------------------------------------------- AsyncAuxSink
+class AsyncAuxSink:
+    """Batched, non-blocking aux fetcher.
+
+    The synchronous loop pays one device→host fetch per step; the sink
+    fetches a whole window of device aux trees in ONE ``device_get`` at
+    flush points.  ``fetch_stalls`` counts flushes that had to wait on
+    results still materializing (detected via ``Array.is_ready`` where
+    the runtime exposes it) and ``fetch_stall_s`` accumulates the wait —
+    the per-step host gap becomes a measured, regression-checked number.
+    """
+
+    def __init__(self):
+        self.pushes = 0  # aux trees deferred instead of fetched
+        self.fetches = 0  # batched device_get calls
+        self.fetched_trees = 0
+        self.fetch_stalls = 0
+        self.fetch_stall_s = 0.0
+
+    def defer(self, n: int = 1) -> None:
+        self.pushes += n
+
+    @staticmethod
+    def _ready(trees) -> bool:
+        import jax
+
+        try:
+            leaves = jax.tree_util.tree_leaves(trees)
+            return all(
+                x.is_ready() for x in leaves if hasattr(x, "is_ready")
+            )
+        except Exception:  # noqa: BLE001 — readiness probe is advisory
+            return True
+
+    def fetch(self, trees: List[Any]) -> List[Any]:
+        """One batched device→host fetch of ``trees``; returns host
+        copies in order."""
+        import jax
+
+        if not trees:
+            return []
+        self.fetches += 1
+        self.fetched_trees += len(trees)
+        stalled = not self._ready(trees)
+        t0 = time.perf_counter()
+        out = jax.device_get(list(trees))
+        dt = time.perf_counter() - t0
+        if stalled:
+            self.fetch_stalls += 1
+            self.fetch_stall_s += dt
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "pushes": self.pushes,
+            "fetches": self.fetches,
+            "fetched_trees": self.fetched_trees,
+            "fetch_stalls": self.fetch_stalls,
+            "fetch_stall_ms": round(self.fetch_stall_s * 1e3, 3),
+        }
+
+
+# ------------------------------------------------------------- PipelinedLoop
+@dataclass
+class _Entry:
+    idx: int
+    batch: Any
+    rng: Any
+    aux: Any  # device aux tree, unfetched
+
+
+class PipelinedLoop:
+    """Guarded training loop with the aux fetch deferred K steps.
+
+    ``aux_interval=1`` delegates every step to the wrapped
+    :class:`GuardedLoop` — byte-identical to the synchronous path.
+    ``aux_interval=K>1`` dispatches K steps back-to-back (the device
+    never waits on a host fetch between them), then flushes: one batched
+    aux fetch, losses checked **in stream order** against the guard's
+    EMA/NaN policy.  A flagged step triggers rollback to the window
+    snapshot, deterministic replay of the verified prefix, a synchronous
+    guarded retry of the poison step (LR backoff → rollback → skip, the
+    usual budgets), and a fresh re-run of the suffix that had executed
+    on the poisoned lineage — so divergence recovery is merely K steps
+    delayed, never weakened.
+
+    ``step_fn`` may donate its input state (the flagship step does):
+    every rollback re-places from the host-side window snapshot and no
+    state object is ever passed to the device twice
+    (``tests/test_pipeline.py`` pins this with real CPU donation).
+
+    Callers must :meth:`flush` at epoch ends and before checkpoints /
+    divergence decisions; ``step``/``flush`` return
+    ``(state, ready, ok)`` where ``ready`` is a list of
+    ``(step_index, host_aux)`` for newly verified steps (empty between
+    flush points) and ``ok`` is False when a poison batch was skipped.
+    """
+
+    def __init__(
+        self,
+        step_fn: Callable,
+        policy: Optional[DivergencePolicy] = None,
+        watchdog: Optional[StepWatchdog] = None,
+        snapshot_every: int = 1,
+        place_fn: Optional[Callable[[Any], Any]] = None,
+        aux_interval: int = 1,
+    ):
+        self._step_fn = step_fn
+        self.aux_interval = max(1, int(aux_interval))
+        self.guard = GuardedLoop(
+            step_fn,
+            policy=policy,
+            watchdog=watchdog,
+            snapshot_every=snapshot_every,
+            place_fn=place_fn,
+        )
+        self._place = place_fn or (lambda tree: tree)
+        self.sink = AsyncAuxSink()
+        self._entries: List[_Entry] = []
+        self._win_snapshot = None
+        self._idx = 0
+        # pipeline-specific counters (guard counters stay on self.guard)
+        self.window_rollbacks = 0
+        self.replayed_steps = 0
+        self.flushes = 0
+
+    # -- delegated counters / snapshot surface (watchdog dumps, summaries)
+    @property
+    def watchdog(self):
+        return self.guard.watchdog
+
+    @watchdog.setter
+    def watchdog(self, wd):
+        self.guard.watchdog = wd
+
+    @property
+    def retried_steps(self) -> int:
+        return self.guard.retried_steps
+
+    @property
+    def rollbacks(self) -> int:
+        return self.guard.rollbacks + self.window_rollbacks
+
+    @property
+    def skipped_batches(self) -> int:
+        return self.guard.skipped_batches
+
+    @property
+    def last_loss(self) -> float:
+        return self.guard.last_loss
+
+    @property
+    def last_snapshot(self):
+        if self.aux_interval > 1:
+            return self._win_snapshot or self.guard.last_snapshot
+        return self.guard.last_snapshot
+
+    @property
+    def steps_since_snapshot(self) -> int:
+        if self.aux_interval > 1:
+            return len(self._entries)
+        return self.guard.steps_since_snapshot
+
+    # -- step execution
+    def _dispatch(self, state, batch, rng, tag: str):
+        wd = self.guard.watchdog
+        if wd is not None:
+            wd.arm(tag=tag)
+        try:
+            return self._step_fn(state, batch, rng)
+        finally:
+            if wd is not None:
+                wd.disarm()
+
+    def step(
+        self, state: Any, batch: Dict[str, Any], rng: Any
+    ) -> Tuple[Any, List[Tuple[int, Dict[str, Any]]], bool]:
+        if self.aux_interval <= 1:
+            idx = self.guard.step_index
+            state, aux, ok = self.guard.step(state, batch, rng)
+            return state, ([(idx, aux)] if ok else []), ok
+        idx = self._idx
+        self._idx += 1
+        self.guard.step_index = self._idx  # shared step coordinate space
+        if self._win_snapshot is None:
+            # BEFORE the first dispatch of a window, as an owning copy:
+            # the step may donate the buffers a device_get view aliases
+            self._win_snapshot = host_copy(state)
+        faults.stall(idx)  # test injection, no-op in production
+        state, aux = self._dispatch(state, batch, rng, tag=str(idx))
+        self._entries.append(_Entry(idx, batch, rng, aux))
+        self.sink.defer()
+        if len(self._entries) >= self.aux_interval:
+            return self._flush(state)
+        return state, [], True
+
+    def flush(
+        self, state: Any
+    ) -> Tuple[Any, List[Tuple[int, Dict[str, Any]]], bool]:
+        """Force a fetch/verify of all pending steps (epoch end,
+        checkpoint, explicit divergence check)."""
+        if self.aux_interval <= 1 or not self._entries:
+            return state, [], True
+        return self._flush(state)
+
+    def _flush(self, state):
+        self.flushes += 1
+        ready: List[Tuple[int, Dict[str, Any]]] = []
+        ok = True
+        entries, self._entries = self._entries, []
+        while entries:
+            wd = self.guard.watchdog
+            if wd is not None:
+                wd.arm(tag=f"flush@{entries[0].idx}")
+            try:
+                hosts = self.sink.fetch([e.aux for e in entries])
+            finally:
+                if wd is not None:
+                    wd.disarm()
+            bad_at, why = -1, ""
+            for i, (e, ah) in enumerate(zip(entries, hosts)):
+                ah = dict(ah)
+                loss = float(np.mean(np.asarray(ah.get("loss", np.nan))))
+                loss = faults.corrupt_loss(e.idx, loss)
+                ah["loss"] = loss
+                bad, why = self.guard.check_loss(loss)
+                if bad:
+                    bad_at = i
+                    break
+                self.guard.note_good(loss)
+                ready.append((e.idx, ah))
+            if bad_at < 0:
+                break
+            e_bad = entries[bad_at]
+            logger.warning(
+                "pipelined flush: step %d diverged (%s) — rolling back "
+                "the window, replaying %d verified step(s), retrying the "
+                "poison step synchronously",
+                e_bad.idx, why, bad_at,
+            )
+            self.window_rollbacks += 1
+            state = self._place(self._win_snapshot)
+            # deterministic replay of the verified prefix: state.step is
+            # restored by the rollback, so the in-graph rng fold
+            # reproduces the identical draws — no progress is lost
+            for e in entries[:bad_at]:
+                state, _ = self._dispatch(state, e.batch, e.rng,
+                                          tag=f"replay@{e.idx}")
+                self.replayed_steps += 1
+            # synchronous guarded retry at the SAME step coordinate so
+            # fault injection / logging line up with the stream position
+            self.guard.step_index = e_bad.idx
+            self.guard._snapshot = None  # guard re-snapshots healthy state
+            state, ah, step_ok = self.guard.step(state, e_bad.batch, e_bad.rng)
+            self.guard.step_index = self._idx
+            if step_ok:
+                ready.append((e_bad.idx, ah))
+            else:
+                ok = False
+            # the suffix ran on the poisoned lineage — re-dispatch fresh
+            redo, entries = entries[bad_at + 1:], []
+            for e in redo:
+                state, aux = self._dispatch(state, e.batch, e.rng,
+                                            tag=f"redo@{e.idx}")
+                self.replayed_steps += 1
+                entries.append(_Entry(e.idx, e.batch, e.rng, aux))
+        # window verified end-to-end: retain its snapshot for the next one
+        self._win_snapshot = host_copy(state)
+        return state, ready, ok
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "aux_interval": self.aux_interval,
+            "steps": self._idx if self.aux_interval > 1 else self.guard.step_index,
+            "flushes": self.flushes,
+            "window_rollbacks": self.window_rollbacks,
+            "replayed_steps": self.replayed_steps,
+            "retried_steps": self.guard.retried_steps,
+            "skipped_batches": self.guard.skipped_batches,
+            **self.sink.stats(),
+        }

@@ -51,6 +51,23 @@ class TrainingDiverged(RuntimeError):
     recovering by skipping, so continuing would silently train garbage."""
 
 
+def host_copy(tree):
+    """Host-side snapshot of ``tree`` that OWNS its memory.
+
+    ``jax.device_get`` on the CPU backend returns zero-copy numpy VIEWS
+    of the runtime buffers.  A donating train step hands exactly those
+    buffers back to XLA for reuse, so a snapshot (or a returned param
+    tree) taken as a bare ``device_get`` silently mutates under the
+    caller — or segfaults once the buffer is unmapped.  Every host tree
+    that must outlive the device state (rollback snapshots, ``fit``'s
+    returned params, best-checkpoint captures) goes through this copy;
+    re-placement is safe because ``device_put`` copies host memory.
+    """
+    import jax
+
+    return jax.tree_util.tree_map(np.array, jax.device_get(tree))
+
+
 @dataclass(frozen=True)
 class RetryPolicy:
     """Bounded deterministic retry — no jitter by design, so a replayed
@@ -246,6 +263,26 @@ class GuardedLoop:
             return True, f"spike {loss:.4g} > {self.policy.spike_factor}x ema {self._ema:.4g}"
         return False, ""
 
+    # The check/accept pair is public so core/pipeline.py's deferred
+    # flush applies the IDENTICAL divergence policy K steps late.
+    def check_loss(self, loss: float) -> Tuple[bool, str]:
+        """Divergence check against the current EMA/warmup state; returns
+        ``(bad, reason)`` without mutating anything."""
+        return self._is_bad(loss)
+
+    def note_good(self, loss: float) -> None:
+        """Record an accepted loss: advance the EMA, warmup counter, and
+        snapshot age exactly as an accepted in-loop step would."""
+        self._seen += 1
+        self._since_snapshot += 1
+        self._ema = (
+            loss
+            if self._ema is None
+            else self.policy.ema_decay * self._ema
+            + (1.0 - self.policy.ema_decay) * loss
+        )
+        self.last_loss = loss
+
     def step(
         self, state: Any, batch: Dict[str, Any], rng: Any
     ) -> Tuple[Any, Dict[str, Any], bool]:
@@ -257,8 +294,9 @@ class GuardedLoop:
         idx = self.step_index
         self.step_index += 1
         if self._snapshot is None or self._since_snapshot >= self.snapshot_every:
-            # BEFORE the step: the step may donate these buffers
-            self._snapshot = jax.device_get(state)
+            # BEFORE the step, and as an owning copy: the step may donate
+            # these buffers, and a device_get view would alias them
+            self._snapshot = host_copy(state)
             self._since_snapshot = 0
 
         aux_host: Dict[str, Any] = {}
@@ -284,15 +322,7 @@ class GuardedLoop:
                 aux_host["loss"] = loss
                 bad, why = self._is_bad(loss)
                 if not bad:
-                    self._seen += 1
-                    self._since_snapshot += 1
-                    self._ema = (
-                        loss
-                        if self._ema is None
-                        else self.policy.ema_decay * self._ema
-                        + (1.0 - self.policy.ema_decay) * loss
-                    )
-                    self.last_loss = loss
+                    self.note_good(loss)
                     return new_state, aux_host, True
                 self.retried_steps += 1
                 logger.warning(

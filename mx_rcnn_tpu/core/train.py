@@ -103,6 +103,7 @@ def make_train_step(
     accum_steps: int = 1,
     fold_step_rng: bool = True,
     steps_per_call: int = 1,
+    deterministic: bool = False,
 ):
     """Build the jitted train step.
 
@@ -240,7 +241,16 @@ def make_train_step(
         fn = step_fn
     if pmean_axis is not None:
         return fn  # caller wraps in shard_map then jit
-    return jax.jit(fn, donate_argnums=(0,) if donate else ())
+    jit_kwargs: Dict[str, Any] = {"donate_argnums": (0,) if donate else ()}
+    # deterministic=True (CPU): legacy XLA:CPU runtime, whose reductions
+    # accumulate serially in a RUN-INDEPENDENT order — the default thunk
+    # runtime reassociates across threads, so even the same executable on
+    # the same inputs drifts ~1e-7 between calls.  Required wherever two
+    # runs must be compared BITWISE (bench.py's pipeline K=1 check);
+    # accelerator backends ignore the cpu-namespaced option.
+    if deterministic and jax.default_backend() == "cpu":
+        jit_kwargs["compiler_options"] = {"xla_cpu_use_thunk_runtime": False}
+    return jax.jit(fn, **jit_kwargs)
 
 
 def stack_batches(batches: Sequence[Dict[str, jnp.ndarray]]) -> Dict[str, Any]:

@@ -23,6 +23,7 @@ import logging
 import os
 import queue
 import threading
+from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -39,15 +40,62 @@ class LoaderFaultBudgetExceeded(RuntimeError):
     """More records failed to load than the configured budget — aborting
     so silent data loss can't masquerade as training."""
 
-# synthetic render cache bound: first-come records keep their render
-# (~7 MB each at flagship size); past the cap, records re-render per
-# access — no OOM cliff on huge synthetic roidbs, full speed for the
-# gate/bench sets that revisit the same images every epoch/sweep.  The
-# counter is a soft cap (unsynchronized prefetch threads may overshoot
-# by a few entries) and is never reclaimed — a >1024-record train roidb
-# can starve later sweeps back to re-rendering, which is slow but safe.
-_RENDER_CACHE_MAX = int(os.environ.get("MX_RCNN_RENDER_CACHE", "1024"))
-_RENDER_CACHE_COUNT = 0
+class _RenderLRU:
+    """Locked LRU of rendered synthetic images, keyed by
+    ``(uri, flipped, seed)``.
+
+    Bounds render-cache memory (~7 MB/entry at flagship size, cap via
+    ``MX_RCNN_RENDER_CACHE``) while keeping the gate/bench sets — which
+    revisit the same few images every epoch/sweep — fully cached.  An
+    LRU rather than the old first-come soft cap: that counter was
+    unsynchronized across prefetch threads and never reclaimed, so a
+    >1024-record train roidb permanently starved every later sweep back
+    to re-rendering.  Recency eviction keeps whatever the CURRENT sweep
+    touches hot instead.  Keying by value (not on the record dict) also
+    makes flip-safety structural: a flipped twin shallow-copied from its
+    source record (``append_flipped_images``) simply has a different
+    key, so it can never be served the unflipped pixels.
+    """
+
+    def __init__(self, max_entries: int):
+        self.max_entries = max(0, int(max_entries))
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple, np.ndarray]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key) -> Optional[np.ndarray]:
+        with self._lock:
+            im = self._entries.get(key)
+            if im is None:
+                self.misses += 1
+            else:
+                self._entries.move_to_end(key)
+                self.hits += 1
+            return im
+
+    def put(self, key, im: np.ndarray) -> None:
+        if self.max_entries <= 0:
+            return
+        with self._lock:
+            self._entries[key] = im
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.hits = self.misses = self.evictions = 0
+
+
+_RENDER_CACHE = _RenderLRU(int(os.environ.get("MX_RCNN_RENDER_CACHE", "1024")))
 
 
 def _load_record_image(rec: Dict) -> np.ndarray:
@@ -57,28 +105,18 @@ def _load_record_image(rec: Dict) -> np.ndarray:
         # synthetic records render from their OWN (already-flipped)
         # geometry — flipping again would move pixels back to the
         # unflipped positions while gt stays flipped, silently training
-        # half the flip-augmented epoch on mismatched targets.
-        # The render is deterministic per record, so cache it on the
-        # record: gate train loops revisit the same few images every
-        # epoch and eval sweeps re-render per pass — at ~17 ms/render
-        # (noise generation) on this 1-core box that was the e2e eval
-        # bottleneck once the relay pipeline overlapped (7.2 MB/image,
-        # disk-backed datasets get the same effect from the OS page
-        # cache).  Read-only downstream: prepare_image copies.
-        # The entry is SELF-VALIDATING, keyed by (uri, flipped, seed):
-        # record dicts get shallow-copied (append_flipped_images,
-        # attach_proposals), so a flipped twin inherits the unflipped
-        # record's "_render" — serving it blind would be exactly the
-        # pixels-vs-gt mismatch the comment above warns about.
+        # half the flip-augmented epoch on mismatched targets.  The
+        # render is deterministic per (uri, flipped, seed), so the LRU
+        # key is exactly that triple; at ~17 ms/render (noise
+        # generation) on a 1-core box re-rendering was the e2e eval
+        # bottleneck once the relay pipeline overlapped (disk-backed
+        # datasets get the same effect from the OS page cache).
+        # Read-only downstream: prepare_image copies.
         key = (rec["image"], bool(rec.get("flipped")), rec["synthetic_seed"])
-        cached = rec.get("_render")
-        if cached is not None and cached[0] == key:
-            return cached[1]
-        im = synthetic_image(rec, rec["synthetic_seed"])
-        global _RENDER_CACHE_COUNT
-        if _RENDER_CACHE_COUNT < _RENDER_CACHE_MAX:
-            rec["_render"] = (key, im)
-            _RENDER_CACHE_COUNT += 1
+        im = _RENDER_CACHE.get(key)
+        if im is None:
+            im = synthetic_image(rec, rec["synthetic_seed"])
+            _RENDER_CACHE.put(key, im)
         return im
     im = load_image(rec["image"])
     if rec.get("flipped"):
@@ -183,52 +221,114 @@ def _orientation_bucket(rec: Dict, buckets) -> Tuple[int, int]:
     return tuple(buckets[0])
 
 
-def _prefetch_iter(source, prefetch: int):
-    """Drain ``source`` through a daemon thread with a bounded queue so
-    host batch assembly overlaps the consumer's device work.  Worker
-    exceptions are re-raised in the consumer — a swallowed decode error
-    would silently truncate an epoch (or an eval sweep, corrupting mAP).
-    Shared by TrainLoader.__iter__ and TestLoader.iter_batched."""
-    if prefetch <= 0:
-        yield from source
-        return
-    q: "queue.Queue" = queue.Queue(maxsize=prefetch)
-    abandoned = threading.Event()
+class PrefetchIterator:
+    """Closeable host-prefetch stage: drains ``source`` through a daemon
+    thread with a bounded queue so host batch assembly overlaps the
+    consumer's device work.
 
-    def _put(msg) -> bool:
+    Worker exceptions are re-raised in the consumer — a swallowed decode
+    error would silently truncate an epoch (or an eval sweep, corrupting
+    mAP).  Shutdown is sentinel-based: :meth:`close` (also the context
+    manager and, as a backstop, GC) signals the worker, drains queued
+    batches, and joins the thread — an abandoned iterator no longer
+    leaks the worker plus ``prefetch + 1`` pinned batches.  Shared by
+    ``TrainLoader.__iter__`` and ``TestLoader.iter_batched``; the
+    device-feed stage (``core/pipeline.py :: DeviceFeed``) stacks on top
+    and closes its source through the same interface.
+
+    ``prefetch <= 0`` degrades to a plain synchronous pass-through (no
+    thread), keeping the deterministic no-thread path tests rely on.
+    """
+
+    def __init__(self, source, prefetch: int):
+        self._closed = threading.Event()
+        self._done = False
+        if prefetch <= 0:
+            self._it = iter(source)
+            self._thread = None
+            return
+        self._it = None
+        self._source = source
+        self._q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        self._thread = threading.Thread(
+            target=self._worker, name="loader-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, msg) -> bool:
         # bounded put that gives up once the consumer is gone — a plain
-        # q.put would park this thread forever when the generator is
+        # q.put would park this thread forever when the iterator is
         # abandoned mid-iteration (exception in the consumer, partial
         # eval, GC), leaking the thread plus prefetch+1 pinned batches
-        while not abandoned.is_set():
+        while not self._closed.is_set():
             try:
-                q.put(msg, timeout=0.2)
+                self._q.put(msg, timeout=0.2)
                 return True
             except queue.Full:
                 continue
         return False
 
-    def worker():
+    def _worker(self):
         try:
-            for item in source:
-                if not _put(("item", item)):
+            for item in self._source:
+                if not self._put(("item", item)):
                     return
-            _put(("stop", None))
+            self._put(("stop", None))
         except BaseException as e:  # noqa: BLE001 — handed to the consumer
-            _put(("err", e))
+            self._put(("err", e))
 
-    t = threading.Thread(target=worker, daemon=True)
-    t.start()
-    try:
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done or self._closed.is_set():
+            raise StopIteration
+        if self._thread is None:
+            return next(self._it)
         while True:
-            kind, payload = q.get()
-            if kind == "stop":
-                return
-            if kind == "err":
-                raise payload
-            yield payload
-    finally:
-        abandoned.set()
+            try:
+                kind, payload = self._q.get(timeout=0.2)
+                break
+            except queue.Empty:
+                if self._closed.is_set():
+                    raise StopIteration from None
+        if kind == "stop":
+            self._done = True
+            raise StopIteration
+        if kind == "err":
+            self._done = True
+            raise payload
+        return payload
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Idempotent: stop the worker, drop queued batches, join."""
+        self._closed.set()
+        if self._thread is None:
+            return
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # abandoned without close(): still reclaim
+        try:
+            self.close(timeout=0.2)
+        except Exception:  # noqa: BLE001 — interpreter may be tearing down
+            pass
+
+
+def _prefetch_iter(source, prefetch: int):
+    """Back-compat alias for :class:`PrefetchIterator`."""
+    return PrefetchIterator(source, prefetch)
 
 
 class TrainLoader:
@@ -376,7 +476,10 @@ class TrainLoader:
             for bucket, idxs in plan
             if (batch := build(bucket, idxs)) is not None
         )
-        yield from _prefetch_iter(source, self.prefetch)
+        # a real PrefetchIterator (not a generator) so consumers that
+        # stop early — or the DeviceFeed stage stacked on top — can
+        # close() it deterministically instead of waiting on GC
+        return PrefetchIterator(source, self.prefetch)
 
 
 class TestLoader:
@@ -446,4 +549,4 @@ class TestLoader:
             return chunk, recs, batch
 
         source = (build(bucket, chunk) for bucket, chunk in plan)
-        yield from _prefetch_iter(source, prefetch)
+        return PrefetchIterator(source, prefetch)

@@ -57,7 +57,9 @@ def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh):
     return jax.device_put(batch, sharding)
 
 
-def make_parallel_train_step(model, tx, mesh: Mesh, accum_steps: int = 1):
+def make_parallel_train_step(
+    model, tx, mesh: Mesh, accum_steps: int = 1, donate: bool = True
+):
     """The DP train step: per-chip compute + pmean on grads/metrics.
 
     Batch arrays arrive sharded on 'data'; state replicated.  Since the
@@ -65,6 +67,9 @@ def make_parallel_train_step(model, tx, mesh: Mesh, accum_steps: int = 1):
     invariant KVStore maintained with explicit broadcasts.
     ``accum_steps`` applies per chip (each shard is scanned into that
     many microbatches before its gradient joins the all-reduce).
+    ``donate`` mirrors ``make_train_step``'s knob (same default: the
+    input state is donated; rollback paths re-place from host
+    snapshots, never reuse a donated buffer).
     """
     inner = make_train_step(model, tx, pmean_axis="data", accum_steps=accum_steps)
 
@@ -90,7 +95,7 @@ def make_parallel_train_step(model, tx, mesh: Mesh, accum_steps: int = 1):
             rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
         return inner(state, batch, rng, lr_scale)
 
-    jitted = jax.jit(sharded_step, donate_argnums=(0,))
+    jitted = jax.jit(sharded_step, donate_argnums=(0,) if donate else ())
 
     def step(state: TrainState, batch, rng, lr_scale=1.0):
         # lr_scale: one-step effective-LR override (replicated scalar) —

@@ -58,6 +58,9 @@ def main():
                     help="reference-style f32 upload + host NMS loop")
     ap.add_argument("--in_flight", type=int, default=2,
                     help="concurrent predict calls in the relay pipeline")
+    ap.add_argument("--feed_depth", type=int, default=2,
+                    help="device-feed staging depth (0 = host batches "
+                         "straight to jit, the pre-pipeline behavior)")
     args = ap.parse_args()
 
     cfg = generate_config(args.network, "PascalVOC")
@@ -104,15 +107,18 @@ def main():
 
     from mx_rcnn_tpu.core.tester import pipelined
 
-    def sweep():
+    def sweep(stats_out=None):
         # threaded relay pipeline (core.tester.pipelined): --in_flight
         # concurrent predict calls overlap upload/compute/fetch across
-        # batches, plus the prefetch thread's next-batch assembly
+        # batches, the DeviceFeed stage's next-batch H2D transfer, plus
+        # the prefetch thread's next-batch assembly
         n_det = 0
         for (idxs, recs), batch, out in pipelined(
             predictor,
             (((idxs, recs), batch) for idxs, recs, batch in loader.iter_batched()),
             in_flight=args.in_flight,
+            feed_depth=args.feed_depth,
+            stats_out=stats_out,
         ):
             if "det_valid" in out:
                 n_det += int(np.asarray(out["det_valid"]).sum())
@@ -132,8 +138,9 @@ def main():
         return n_det
 
     sweep()  # warmup / compile
+    feed_stats: dict = {}
     t0 = time.perf_counter()
-    n_det = sweep()
+    n_det = sweep(stats_out=feed_stats)
     dt = time.perf_counter() - t0
     imgs_per_sec = args.images / dt
     print(
@@ -145,6 +152,7 @@ def main():
                 "batch": args.batch,
                 "detections": int(n_det),
                 "path": "host" if args.host_path else "device",
+                "feed": feed_stats or None,
             }
         )
     )

@@ -33,6 +33,7 @@ import numpy as np
 import optax
 
 from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.resilience import host_copy
 from mx_rcnn_tpu.core.tester import Predictor, pred_eval
 from mx_rcnn_tpu.core.train import create_train_state, make_optimizer, make_train_step
 from mx_rcnn_tpu.data.loader import TestLoader, TrainLoader
@@ -271,7 +272,9 @@ def run_gate(
                 # keep the checkpoint the reported metrics describe, so
                 # the decoupled mask-IoU below measures the SAME params
                 # as the best mAP/segm_AP50 (not the final state's)
-                best_params = jax.device_get(state.params)
+                # owning copy, not a device_get view: the DP step donates
+                # its state, so later steps reuse these very buffers
+                best_params = host_copy(state.params)
             logger.info("step %d loss %.3f gate %.3f", done, loss, m)
             if best >= target:
                 break
@@ -289,7 +292,7 @@ def run_gate(
         # measured on the best checkpoint, the one the AP numbers describe
         probe_params = (
             best_params if best_params is not None
-            else jax.device_get(state.params)
+            else host_copy(state.params)
         )
         out["mask_iou"] = round(
             mask_iou_eval(model, probe_params, cfg, roidb), 4

@@ -29,9 +29,9 @@ from mx_rcnn_tpu.core.checkpoint import (
     save_checkpoint,
 )
 from mx_rcnn_tpu.core.metrics import MetricTracker, Speedometer
+from mx_rcnn_tpu.core.pipeline import DeviceFeed, PipelinedLoop, make_place_fn
 from mx_rcnn_tpu.core.resilience import (
     DivergencePolicy,
-    GuardedLoop,
     StepWatchdog,
 )
 from mx_rcnn_tpu.core.train import (
@@ -117,6 +117,16 @@ def parse_args(argv=None):
     p.add_argument("--loader_failure_budget", type=int, default=None,
                    help="abort after this many records fail to load "
                         "(default: max(32, 1%% of the roidb))")
+    # device-resident pipeline (core/pipeline.py): double-buffered
+    # host->device feed + K-late aux fetch
+    p.add_argument("--feed_depth", type=int, default=2, metavar="N",
+                   help="device-feed double-buffer depth: batches staged "
+                        "on device ahead of the running step")
+    p.add_argument("--aux_interval", type=int, default=0, metavar="K",
+                   help="fetch train aux every K steps instead of every "
+                        "step (divergence checks run K late against the "
+                        "retained window snapshot); 0 = auto: 1 on CPU "
+                        "(exact sync-loop behavior), 8 on accelerators")
     return p.parse_args(argv)
 
 
@@ -284,11 +294,15 @@ def train_net(args):
     if jax.process_index() == 0:
         save_run_meta(args.prefix, cfg)
 
-    # resilience: every step runs under the guarded loop (NaN/spike →
-    # retry with LR backoff → rollback + skip); an optional watchdog
-    # turns a hung step into a resumable checkpoint + exit 75 instead of
-    # an rc=124 external kill (the MULTICHIP_r04 failure mode)
-    guard = GuardedLoop(
+    # resilience + pipeline: every step runs under the pipelined guarded
+    # loop (NaN/spike → retry with LR backoff → rollback + skip, K steps
+    # late when --aux_interval > 1); an optional watchdog turns a hung
+    # step into a resumable checkpoint + exit 75 instead of an rc=124
+    # external kill (the MULTICHIP_r04 failure mode)
+    aux_interval = args.aux_interval or (
+        1 if jax.default_backend() == "cpu" else 8
+    )
+    pipeline = PipelinedLoop(
         step_fn,
         policy=DivergencePolicy(
             spike_factor=args.spike_factor,
@@ -296,24 +310,29 @@ def train_net(args):
         ),
         snapshot_every=args.snapshot_every,
         place_fn=(lambda t: replicate(t, mesh)) if use_mesh else None,
+        aux_interval=aux_interval,
     )
+    # one placement path for every topology: single chip, DP mesh
+    # (shard_batch), multi-host (globalize_batch) — run by the feed's
+    # worker thread so batch N+1's transfer overlaps step N
+    batch_place = make_place_fn(mesh if use_mesh else None)
     loop_pos = {"epoch": begin_epoch, "batch": begin_batch}
     if args.step_timeout > 0:
         def _watchdog_dump():
-            snap = guard.last_snapshot
+            snap = pipeline.last_snapshot
             if snap is None or jax.process_index() != 0:
                 return None
             # the snapshot lags the stream by steps_since_snapshot —
             # name the dump at ITS position so resume re-consumes the
             # un-snapshotted batches rather than silently skipping them
             batch_pos = max(
-                0, loop_pos["batch"] - guard.steps_since_snapshot
+                0, loop_pos["batch"] - pipeline.steps_since_snapshot
             )
             return save_checkpoint(
                 args.prefix, snap, loop_pos["epoch"], batch_pos
             )
 
-        guard.watchdog = StepWatchdog(
+        pipeline.watchdog = StepWatchdog(
             args.step_timeout, dump_fn=_watchdog_dump
         )
 
@@ -347,43 +366,61 @@ def train_net(args):
     tracing = False
     preempted = False
     preempt_guard = PreemptionGuard()
+
+    def deliver(ready):
+        for _idx, aux in ready:
+            tracker.update({k: float(v) for k, v in aux.items()})
+
+    def flush_pipeline(state):
+        # force the deferred aux checks before any checkpoint/summary:
+        # a divergence inside the window must roll back NOW, not after
+        # the bad state has been persisted
+        state, ready, _ok = pipeline.flush(state)
+        deliver(ready)
+        return state
+
     try:
         for epoch in range(begin_epoch, args.epochs):
             batch_in_epoch = begin_batch if epoch == begin_epoch else 0
-            for batch in loader:
-                loop_pos["epoch"], loop_pos["batch"] = epoch, batch_in_epoch
-                if use_mesh:
-                    batch = distributed.globalize_batch(batch, mesh)
-                # profiler window: skip compile/warmup, capture steady
-                # state (SURVEY §5.2 — the reference had a Speedometer)
-                if args.profile and total_steps == 10:
-                    jax.profiler.start_trace(args.profile)
-                    tracing = True
-                state, aux, step_ok = guard.step(state, batch, rng)
-                if step_ok:
-                    tracker.update({k: float(v) for k, v in aux.items()})
-                total_steps += 1
-                batch_in_epoch += 1
-                if args.profile and total_steps == 20:
-                    jax.profiler.stop_trace()
-                    tracing = False
-                    logger.info("profiler trace written to %s", args.profile)
-                speedo(epoch, total_steps, tracker)
-                if _stop_agreed(preempt_guard.should_stop, total_steps):
-                    # preemption: mid-epoch checkpoint resume picks up
-                    preempted = True
-                    if jax.process_index() == 0:
-                        path = save_checkpoint(
-                            args.prefix, jax.device_get(state),
-                            epoch, batch_in_epoch,
-                        )
-                        logger.info(
-                            "preempted at epoch %d batch %d — checkpoint -> %s",
-                            epoch, batch_in_epoch, path,
-                        )
-                    break
-                if args.max_steps and total_steps >= args.max_steps:
-                    break
+            feed = DeviceFeed(
+                iter(loader), place_fn=batch_place, depth=args.feed_depth
+            )
+            try:
+                for batch in feed:
+                    loop_pos["epoch"], loop_pos["batch"] = epoch, batch_in_epoch
+                    # profiler window: skip compile/warmup, capture steady
+                    # state (SURVEY §5.2 — the reference had a Speedometer)
+                    if args.profile and total_steps == 10:
+                        jax.profiler.start_trace(args.profile)
+                        tracing = True
+                    state, ready, _step_ok = pipeline.step(state, batch, rng)
+                    deliver(ready)
+                    total_steps += 1
+                    batch_in_epoch += 1
+                    if args.profile and total_steps == 20:
+                        jax.profiler.stop_trace()
+                        tracing = False
+                        logger.info("profiler trace written to %s", args.profile)
+                    speedo(epoch, total_steps, tracker)
+                    if _stop_agreed(preempt_guard.should_stop, total_steps):
+                        # preemption: mid-epoch checkpoint resume picks up
+                        preempted = True
+                        state = flush_pipeline(state)
+                        if jax.process_index() == 0:
+                            path = save_checkpoint(
+                                args.prefix, jax.device_get(state),
+                                epoch, batch_in_epoch,
+                            )
+                            logger.info(
+                                "preempted at epoch %d batch %d — checkpoint -> %s",
+                                epoch, batch_in_epoch, path,
+                            )
+                        break
+                    if args.max_steps and total_steps >= args.max_steps:
+                        break
+            finally:
+                feed.close()
+            state = flush_pipeline(state)
             if preempted:
                 break
             if jax.process_index() == 0:
@@ -397,12 +434,12 @@ def train_net(args):
                 break
     finally:
         preempt_guard.uninstall()
-        if guard.skipped_batches or loader.record_failures:
+        if pipeline.skipped_batches or loader.record_failures:
             logger.warning(
                 "resilience summary: %d poison batch(es) skipped via "
                 "rollback (%d step retries), %d record(s) failed to load "
                 "(%d substituted, %d batches dropped)",
-                guard.skipped_batches, guard.retried_steps,
+                pipeline.skipped_batches, pipeline.retried_steps,
                 loader.record_failures, loader.substituted_records,
                 loader.dropped_batches,
             )

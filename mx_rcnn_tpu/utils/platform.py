@@ -15,10 +15,27 @@ import os
 def enable_compile_cache(path: str = "/tmp/jax_cache") -> None:
     """Persistent XLA compilation cache — first compiles of the big train
     graphs take minutes (especially through the axon remote-compile
-    tunnel); every later process reuses them."""
+    tunnel); every later process reuses them.
+
+    Entries live under a subdirectory keyed by the pieces of the XLA
+    environment that change generated code but escape jax's cache key —
+    notably ``XLA_FLAGS`` (``--xla_force_host_platform_device_count``):
+    an executable the test env compiled under 8 virtual CPU devices,
+    replayed in a 1-device tool process, is not even run-to-run
+    deterministic (measured: it flips ``bench.py --pipeline``'s K=1
+    bitwise check on identical inputs).
+    """
+    import hashlib
+
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", path)
+    env = "|".join((
+        os.environ.get("XLA_FLAGS", ""),
+        jax.default_backend(),
+        str(jax.device_count()),
+    ))
+    sub = os.path.join(path, hashlib.sha1(env.encode()).hexdigest()[:8])
+    jax.config.update("jax_compilation_cache_dir", sub)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 

@@ -138,9 +138,10 @@ def test_prefetch_iter_propagates_worker_exception():
 
 
 def test_synthetic_render_cache_is_flip_safe():
-    """A flipped twin shallow-copies its source record and inherits the
-    cached unflipped render; the self-validating cache key must refuse
-    it and render from the flipped geometry (pixels match flipped gt)."""
+    """A flipped twin shallow-copies its source record; the render LRU
+    keys on (uri, flipped, seed), so the twin must MISS the unflipped
+    entry and render from the flipped geometry (pixels match flipped
+    gt)."""
     from mx_rcnn_tpu.data.imdb import IMDB
     from mx_rcnn_tpu.data.loader import _load_record_image
 
@@ -151,7 +152,6 @@ def test_synthetic_render_cache_is_flip_safe():
     both = IMDB.append_flipped_images(roidb)
     for rec, im_plain in zip(both[len(roidb):], plain):
         assert rec.get("flipped")
-        assert "_render" in rec  # inherited stale entry
         im_flip = _load_record_image(rec)
         # must equal a FRESH render from the flipped geometry (the
         # noise background is seed-anchored, not mirrored, so this is
