@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast resilience bench serve pipeline integration-gate clean-native
+.PHONY: native test test-kernels test-fast resilience bench bench-eval eval-bench serve pipeline integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -55,6 +55,13 @@ bench:
 # inference throughput (host-bound on weak dev hosts; see the docstring)
 bench-eval:
 	$(PY) -m mx_rcnn_tpu.tools.bench_eval
+
+# eval host data plane bench (ISSUE 5): parallel assembly + prepared
+# cache + completion pool around a stub device at flagship image size;
+# serial vs overlapped img/s, stage counters, bitwise detection check;
+# emits JSON lines + the BENCH_eval_cpu.json artifact
+eval-bench:
+	JAX_PLATFORMS=cpu $(PY) bench.py --eval --out BENCH_eval_cpu.json
 
 # online serving load test (mixed-size synthetic traffic through the
 # dynamic batcher + shape-bucket ladder; SERVING.md); CPU-runnable.

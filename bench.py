@@ -237,6 +237,46 @@ def _smoke_config(batch_images: int):
     )
 
 
+def _eval_records(report: dict) -> list:
+    """Eval data-plane report (``tools/bench_eval.py ::
+    data_plane_report``) → the JSON-line records (pure; the bench schema
+    test builds a synthetic report and asserts the throughput, stage
+    counters, and bitwise-equivalence fields are present without running
+    the benchmark).
+
+    ``vs_baseline`` on the throughput record is the overlapped/serial
+    ratio measured IN THE SAME PROCESS over the identical seeded stream —
+    reportable only because ``byte_identical`` holds.
+    """
+    over = report["overlapped"]
+    assembly = over.get("assembly", {})
+    completion = over.get("completion", {})
+    cache = report.get("prepared_cache_stats", {})
+
+    def rec(metric, value, unit, vs=None):
+        return {"metric": metric, "value": value, "unit": unit,
+                "vs_baseline": vs}
+
+    return [
+        rec("eval_data_plane_imgs_per_sec",
+            report["overlapped_imgs_per_sec"], "imgs/sec",
+            vs=report["speedup"]),
+        rec("eval_data_plane_serial_imgs_per_sec",
+            report["baseline_imgs_per_sec"], "imgs/sec"),
+        rec("eval_assembly_occupancy",
+            assembly.get("occupancy", 0.0), "fraction"),
+        rec("eval_assembly_queue_depth_max",
+            assembly.get("queue_depth_max", 0), "batches"),
+        rec("eval_completion_inflight_max",
+            completion.get("inflight_max", 0), "tasks"),
+        rec("eval_completion_block_s",
+            completion.get("block_s", 0.0), "seconds"),
+        rec("eval_in_flight_window", report["in_flight"], "batches"),
+        rec("eval_prepared_cache_hits", cache.get("hits", 0), "hits"),
+        rec("eval_byte_identical", int(report["byte_identical"]), "bool"),
+    ]
+
+
 def _pipeline_records(report: dict) -> list:
     """Pipeline report → the JSON-line records (pure; the bench schema
     test builds a synthetic report and asserts the feed-occupancy and
@@ -418,6 +458,20 @@ def main():
              "fetch stalls, K=1 byte-identical check) on the CPU smoke "
              "config",
     )
+    ap.add_argument(
+        "--eval", dest="eval_plane", action="store_true",
+        help="bench the eval host data plane (parallel assembly + "
+             "prepared cache + completion pool) around a stub device at "
+             "flagship image size; serial vs overlapped, bitwise check",
+    )
+    ap.add_argument("--eval_images", type=int, default=64)
+    ap.add_argument("--eval_batch", type=int, default=8)
+    ap.add_argument("--stub_device_ms", type=float, default=110.0,
+                    help="stub device stall per batch (110 ms = the "
+                         "73 img/s device ceiling at b8, ROOFLINE r5)")
+    ap.add_argument("--assembly_workers", type=int, default=2)
+    ap.add_argument("--postprocess_workers", type=int, default=2)
+    ap.add_argument("--prepared_cache", type=int, default=128)
     ap.add_argument("--pipeline_steps", type=int, default=16)
     ap.add_argument("--aux_interval", type=int, default=4,
                     help="K: train aux fetched every K steps")
@@ -433,6 +487,25 @@ def main():
     from mx_rcnn_tpu.utils.platform import enable_compile_cache
 
     enable_compile_cache()
+
+    if args.eval_plane:
+        from mx_rcnn_tpu.tools.bench_eval import data_plane_report
+
+        report = data_plane_report(
+            images=args.eval_images,
+            batch=args.eval_batch,
+            stub_device_ms=args.stub_device_ms,
+            assembly_workers=args.assembly_workers,
+            postprocess_workers=args.postprocess_workers,
+            prepared_cache=args.prepared_cache,
+        )
+        records = _eval_records(report)
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
 
     if args.pipeline:
         records, report = bench_pipeline(

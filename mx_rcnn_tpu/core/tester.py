@@ -110,33 +110,51 @@ def pipelined(
     in_flight: int = 2,
     feed_depth: int = 2,
     stats_out: Optional[Dict] = None,
+    mode: str = "auto",
 ):
     """Overlapped eval pipeline shared by pred_eval / generate_proposals
-    / bench_eval: keeps ``in_flight`` predict calls running in a small
-    thread pool and yields ``(payload, batch, outputs)`` in input order.
+    / bench_eval: keeps ``in_flight`` forwards in motion and yields
+    ``(payload, batch, outputs)`` in input order.
 
-    Why threads and not plain async dispatch: on a relay-attached TPU
-    the per-batch serial chain is upload → compute → fetch (measured
-    b8 flagship: 135 + 72 + ~130 ms), and the relay does NOT overlap
-    stages of successive one-thread dispatches (depth-2 async dispatch
-    measured 0% faster).  Two concurrent requests from separate threads
-    DO overlap (the GIL drops during relay I/O): measured 424 →
-    279 ms/batch device-side (3 threads: 266).  Results are consumed in
-    submission order, so downstream accumulation is order-identical to
-    the serial loop (``tests/test_postprocess.py`` equivalence).
+    Two dispatch modes, selected by ``mode`` (``"auto"`` picks per
+    backend):
+
+    * ``"threads"`` (non-CPU default): ``in_flight`` blocking
+      :meth:`Predictor.predict` calls in a small thread pool.  On a
+      relay-attached TPU the per-batch serial chain is upload → compute
+      → fetch (measured b8 flagship: 135 + 72 + ~130 ms) and the relay
+      does NOT overlap stages of successive one-thread dispatches
+      (depth-2 async dispatch measured 0% faster) — but two concurrent
+      requests from separate threads DO overlap (the GIL drops during
+      relay I/O): measured 424 → 279 ms/batch device-side (3 threads:
+      266).
+    * ``"async"`` (CPU default): :meth:`Predictor.predict_async` from
+      the dispatch thread with a bounded in-flight window, forcing
+      (``jax.device_get``) only when a result is consumed — no predict
+      threads, so the dispatch thread stays free to run the completion
+      pool's backpressure and local runtimes queue the window natively.
+
+    Either way results are consumed in submission order, so downstream
+    accumulation is order-identical to the serial loop
+    (``tests/test_postprocess.py`` equivalence).
 
     Eval draws device-feed from the same pipeline stage as training:
     ``feed_depth`` > 0 stacks a :class:`~mx_rcnn_tpu.core.pipeline
-    .DeviceFeed` between the host batches and the predict pool, so
+    .DeviceFeed` between the host batches and the predict stage, so
     batch N+1's H2D transfer overlaps batch N's forward (0 disables —
     the batches then reach jit as host numpy).  ``stats_out``, if given,
-    receives the feed's occupancy counters on exit.
+    receives the feed's occupancy counters plus the resolved mode on
+    exit.
     """
     from collections import deque
     from concurrent.futures import ThreadPoolExecutor
 
     from mx_rcnn_tpu.core.pipeline import DeviceFeed
 
+    if mode == "auto":
+        mode = "async" if jax.default_backend() == "cpu" else "threads"
+    if mode not in ("async", "threads"):
+        raise ValueError(f"unknown pipelined mode {mode!r}")
     feed = None
     source = batches
     if feed_depth > 0:
@@ -149,23 +167,42 @@ def pipelined(
             name="eval-device-feed",
         )
         source = feed
-    ex = ThreadPoolExecutor(max_workers=max(in_flight, 1))
+    window = max(in_flight, 1)
     q: deque = deque()
+    ex = None
     try:
-        for payload, batch in source:
-            q.append((payload, batch, ex.submit(predictor.predict, batch)))
-            while len(q) > max(in_flight, 1):
+        if mode == "async":
+            for payload, batch in source:
+                q.append((payload, batch, predictor.predict_async(batch)))
+                while len(q) > window:
+                    p, b, o = q.popleft()
+                    yield p, b, jax.device_get(o)
+            while q:
+                p, b, o = q.popleft()
+                yield p, b, jax.device_get(o)
+        else:
+            ex = ThreadPoolExecutor(max_workers=window)
+            for payload, batch in source:
+                q.append(
+                    (payload, batch, ex.submit(predictor.predict, batch))
+                )
+                while len(q) > window:
+                    p, b, f = q.popleft()
+                    yield p, b, f.result()
+            while q:
                 p, b, f = q.popleft()
                 yield p, b, f.result()
-        while q:
-            p, b, f = q.popleft()
-            yield p, b, f.result()
     finally:
-        # wait=True: on early abandonment (consumer raised/broke out),
-        # drain the in-flight predicts (~one batch chain) rather than
-        # leaving orphan threads driving the relay under whatever the
-        # caller does next; queued-but-unstarted work is cancelled
-        ex.shutdown(wait=True, cancel_futures=True)
+        if ex is not None:
+            # wait=True: on early abandonment (consumer raised/broke
+            # out), drain the in-flight predicts (~one batch chain)
+            # rather than leaving orphan threads driving the relay under
+            # whatever the caller does next; queued-but-unstarted work
+            # is cancelled
+            ex.shutdown(wait=True, cancel_futures=True)
+        if stats_out is not None:
+            stats_out["mode"] = mode
+            stats_out["in_flight"] = window
         if feed is not None:
             if stats_out is not None:
                 stats_out.update(feed.stats())
@@ -213,6 +250,9 @@ def pred_eval(
     vis: Optional[str] = None,
     dump_path: Optional[str] = None,
     vis_thresh: float = 0.7,
+    postprocess_workers: Optional[int] = None,
+    assembly_workers: Optional[int] = None,
+    stats_out: Optional[Dict] = None,
 ):
     """Full-dataset evaluation loop (pred_eval twin).
 
@@ -221,7 +261,23 @@ def pred_eval(
     pickle that ``tools/reeval.py`` re-scores (the reference's
     detections.pkl); ``vis`` names a directory that receives per-image
     detection overlays (vis_all_detection twin).
+
+    Host data plane (ISSUE 5): assembly can run in a worker pool
+    (``assembly_workers``, batched loaders only) and the per-image
+    postprocess — detections, capping, mask RLE encoding — runs in a
+    :class:`~mx_rcnn_tpu.data.assembler.CompletionPool`
+    (``postprocess_workers``; None → ``MX_RCNN_POSTPROCESS_WORKERS``,
+    default 0 = inline on the dispatch thread).  Accumulation is
+    index-addressed (``all_boxes[cls][img]``), so the result is
+    identical no matter which worker finishes first; worker errors
+    re-raise at the final ``drain``.  ``stats_out`` receives the
+    completion-pool counters.
     """
+    import os as _os
+    import threading
+
+    from mx_rcnn_tpu.data.assembler import CompletionPool
+
     te = cfg.TEST
     thresh = te.SCORE_THRESH if thresh is None else thresh
     num_classes = imdb.num_classes
@@ -243,10 +299,16 @@ def pred_eval(
     all_masks: Optional[List[List[list]]] = None
     t0 = time.time()
     done = 0
+    # all_boxes/all_masks slot writes are disjoint per image index; the
+    # lock covers the only cross-image state (lazy all_masks creation
+    # and the progress counter)
+    acc_lock = threading.Lock()
 
     def process_image(i: int, rec: Dict, out, batch, k: int = 0):
         """Accumulate detections for dataset image ``i`` from the
-        ``k``-th slot of a (possibly batched) forward's outputs."""
+        ``k``-th slot of a (possibly batched) forward's outputs.  Pure
+        per image except the index-addressed slot writes — safe from
+        any completion worker."""
         nonlocal all_masks, done
         # the canonical per-image postprocess lives in serve/runner.py
         # (one decode path shared by eval, demo, and the serving engine);
@@ -266,52 +328,79 @@ def pred_eval(
         cls_dets, mask_probs = cap_detections(
             cls_dets, te.MAX_PER_IMAGE, mask_probs
         )
+        rles = None
+        if mask_probs is not None:
+            from mx_rcnn_tpu.eval.segm import rles_for_detections
+
+            rles = {
+                j: rles_for_detections(
+                    mask_probs[j], cls_dets[j], rec["height"], rec["width"]
+                )
+                for j in range(1, num_classes)
+            }
         for j in range(1, num_classes):
             all_boxes[j][i] = cls_dets[j]
-        if mask_probs is not None:
-            if all_masks is None:
-                all_masks = [
-                    [[] for _ in range(num_images)] for _ in range(num_classes)
-                ]
-            from mx_rcnn_tpu.eval.segm import mask_to_rle
-
+        if rles is not None:
+            with acc_lock:
+                if all_masks is None:
+                    all_masks = [
+                        [[] for _ in range(num_images)]
+                        for _ in range(num_classes)
+                    ]
             for j in range(1, num_classes):
-                all_masks[j][i] = [
-                    mask_to_rle(p, b[:4], rec["height"], rec["width"])
-                    for p, b in zip(mask_probs[j], all_boxes[j][i])
-                ]
+                all_masks[j][i] = rles[j]
         if vis:
-            import os
-
             from mx_rcnn_tpu.data.loader import _load_record_image
             from mx_rcnn_tpu.utils.visualize import draw_detections, save_image
 
-            os.makedirs(vis, exist_ok=True)
+            _os.makedirs(vis, exist_ok=True)
             dets_by_class = {
                 imdb.classes[j]: all_boxes[j][i] for j in range(1, num_classes)
             }
             im = draw_detections(_load_record_image(rec), dets_by_class, vis_thresh)
-            save_image(os.path.join(vis, f"det_{i:06d}.png"), im)
-        done += 1
-        if done % 100 == 0:
+            save_image(_os.path.join(vis, f"det_{i:06d}.png"), im)
+        with acc_lock:
+            done += 1
+            n_done = done
+        if n_done % 100 == 0:
             logger.info(
-                "im_detect %d/%d %.3fs/im", done, num_images, (time.time() - t0) / done
+                "im_detect %d/%d %.3fs/im", n_done, num_images,
+                (time.time() - t0) / n_done,
             )
 
-    if getattr(loader, "batch_size", 1) > 1:
-        # batched device forwards (beyond-reference: the reference tester
-        # is batch=1); dataset order is restored through the indices
-        for (idxs, recs), batch, out in pipelined(
-            predictor,
-            (((idxs, recs), batch) for idxs, recs, batch in loader.iter_batched()),
-        ):
-            for k, (i, rec) in enumerate(zip(idxs, recs)):
-                process_image(i, rec, out, batch, k)
-    else:
-        for (i, rec), batch, out in pipelined(
-            predictor, (((i, rec), batch) for i, (rec, batch) in enumerate(loader))
-        ):
-            process_image(i, rec, out, batch)
+    workers = (
+        max(0, int(_os.environ.get("MX_RCNN_POSTPROCESS_WORKERS", "0")))
+        if postprocess_workers is None
+        else max(0, int(postprocess_workers))
+    )
+    completion = CompletionPool(workers, name="eval-complete")
+    try:
+        if getattr(loader, "batch_size", 1) > 1:
+            # batched device forwards (beyond-reference: the reference
+            # tester is batch=1); dataset order is restored through the
+            # indices, so completion can run out of order
+            for (idxs, recs), batch, out in pipelined(
+                predictor,
+                (
+                    ((idxs, recs), batch)
+                    for idxs, recs, batch in loader.iter_batched(
+                        assembly_workers=assembly_workers
+                    )
+                ),
+            ):
+                for k, (i, rec) in enumerate(zip(idxs, recs)):
+                    completion.submit(process_image, i, rec, out, batch, k)
+        else:
+            for (i, rec), batch, out in pipelined(
+                predictor,
+                (((i, rec), batch) for i, (rec, batch) in enumerate(loader)),
+            ):
+                completion.submit(process_image, i, rec, out, batch)
+        completion.drain()
+    finally:
+        completion.close()
+        if stats_out is not None:
+            stats_out["completion"] = completion.stats()
     if dump_path:
         with open(dump_path, "wb") as f:
             pickle.dump(all_boxes, f, pickle.HIGHEST_PROTOCOL)

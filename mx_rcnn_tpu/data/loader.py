@@ -30,6 +30,7 @@ import numpy as np
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.core.resilience import RetryPolicy
+from mx_rcnn_tpu.data.assembler import AssemblyPool, default_assembly_workers
 from mx_rcnn_tpu.data.image import load_image, pick_bucket, prepare_image
 from mx_rcnn_tpu.utils import faults
 
@@ -96,6 +97,38 @@ class _RenderLRU:
 
 
 _RENDER_CACHE = _RenderLRU(int(os.environ.get("MX_RCNN_RENDER_CACHE", "1024")))
+
+# Prepared-canvas LRU: the (padded image, im_info) PAIR after resize /
+# normalize-or-quantize / bucket-pad — the ~80 ms/img assembly tail the
+# render cache doesn't cover.  Eval sweeps and the bench revisit the
+# same records every pass, so the second pass skips assembly entirely.
+# Keyed by record identity AND every input of the prep math (scales,
+# bucket, uint8 flag, normalization constants), so a hit is bit-identical
+# to recomputation by construction.  Default OFF (entries=0): a train
+# stream with flip augmentation rarely revisits a key before eviction,
+# and a flagship canvas is ~3 MB — opt in via MX_RCNN_PREPARED_CACHE or
+# :func:`set_prepared_cache` where revisits are the workload (bench,
+# repeated eval).
+_PREPARED_CACHE = _RenderLRU(int(os.environ.get("MX_RCNN_PREPARED_CACHE", "0")))
+
+
+def set_prepared_cache(max_entries: int) -> None:
+    """Resize (and clear) the prepared-canvas LRU at runtime — the
+    bench/tools hook; the env var covers child processes."""
+    _PREPARED_CACHE.clear()
+    _PREPARED_CACHE.max_entries = max(0, int(max_entries))
+
+
+def _prepared_key(rec: Dict, scales, bucket, uint8: bool, means, stds):
+    """Cache key = record identity + every parameter of the prep math."""
+    base = (rec["image"], bool(rec.get("flipped")))
+    if "synthetic_seed" in rec:
+        base += (rec["synthetic_seed"],)
+    norm = (
+        None if uint8
+        else (tuple(np.ravel(means).tolist()), tuple(np.ravel(stds).tolist()))
+    )
+    return base + (tuple(scales), tuple(bucket), uint8, norm)
 
 
 def _load_record_image(rec: Dict) -> np.ndarray:
@@ -169,16 +202,31 @@ def make_batch(
         proposals = np.zeros((n, proposal_count, 4), np.float32)
         prop_valid = np.zeros((n, proposal_count), bool)
     for i, rec in enumerate(records):
-        im = images[i] if images is not None else _load_record_image(rec)
-        padded, info = prepare_image(
-            im,
-            scales[0],
-            scales[1],
-            cfg.network.PIXEL_MEANS,
-            cfg.network.PIXEL_STDS,
-            [bucket],
-            uint8_out=uint8_images,
-        )
+        # prepared-canvas cache: only for loader-owned loads (a caller
+        # passing ``images`` may have substituted fault slots, whose
+        # pixels no longer match the record key)
+        key = None
+        prepared = None
+        if images is None and _PREPARED_CACHE.max_entries > 0:
+            key = _prepared_key(
+                rec, scales, bucket, uint8_images,
+                cfg.network.PIXEL_MEANS, cfg.network.PIXEL_STDS,
+            )
+            prepared = _PREPARED_CACHE.get(key)
+        if prepared is None:
+            im = images[i] if images is not None else _load_record_image(rec)
+            prepared = prepare_image(
+                im,
+                scales[0],
+                scales[1],
+                cfg.network.PIXEL_MEANS,
+                cfg.network.PIXEL_STDS,
+                [bucket],
+                uint8_out=uint8_images,
+            )
+            if key is not None:
+                _PREPARED_CACHE.put(key, prepared)
+        padded, info = prepared
         out_images[i] = padded
         im_info[i] = info
         boxes = rec["boxes"] * info[2]
@@ -331,6 +379,56 @@ def _prefetch_iter(source, prefetch: int):
     return PrefetchIterator(source, prefetch)
 
 
+class _AssembledStream:
+    """Closeable iterator over pool-assembled batches — the
+    ``assembly_workers > 0`` twin of :class:`PrefetchIterator`, so
+    consumers (DeviceFeed, ``pipelined``, early-stopping eval) tear
+    down either path through the same ``close()``.
+
+    Drops ``None`` results (whole-batch failures already accounted by
+    the loader's fault counters); worker exceptions — including
+    :class:`LoaderFaultBudgetExceeded` — surface at their submission
+    position, exactly where the serial loop would have raised.
+    ``stats()`` exposes the pool's occupancy counters for the bench.
+    """
+
+    def __init__(self, pool: AssemblyPool, results):
+        self._pool = pool
+        self._results = results
+
+    def __iter__(self) -> "_AssembledStream":
+        return self
+
+    def __next__(self):
+        while True:
+            try:
+                out = next(self._results)
+            except StopIteration:
+                self._pool.close()
+                raise
+            if out is not None:
+                return out
+
+    def stats(self) -> Dict:
+        return self._pool.stats()
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._results.close()
+        self._pool.close()
+
+    def __enter__(self) -> "_AssembledStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter may be tearing down
+            pass
+
+
 class TrainLoader:
     """AnchorLoader twin: shuffled, aspect-grouped, bucket-padded batches.
 
@@ -357,6 +455,7 @@ class TrainLoader:
         row_slice: Optional[slice] = None,
         retry: Optional[RetryPolicy] = None,
         failure_budget: Optional[int] = None,
+        assembly_workers: Optional[int] = None,
     ):
         self.roidb = roidb
         self.cfg = cfg
@@ -384,6 +483,12 @@ class TrainLoader:
         self.record_failures = 0
         self.substituted_records = 0
         self.dropped_batches = 0
+        # None → MX_RCNN_ASSEMBLY_WORKERS (default 0 = the serial
+        # prefetch path); > 0 assembles batches in an AssemblyPool
+        self.assembly_workers = assembly_workers
+        # fault accounting is shared mutable state once assembly goes
+        # parallel: counters and the budget check update atomically
+        self._fault_lock = threading.Lock()
 
     def _load_guarded(self, i: int) -> Optional[np.ndarray]:
         """Load record ``i``'s image with bounded retry; None = the
@@ -397,16 +502,18 @@ class TrainLoader:
         try:
             return self.retry.run(attempt)
         except Exception as e:  # noqa: BLE001 — any read/decode failure
-            self.record_failures += 1
+            with self._fault_lock:
+                self.record_failures += 1
+                failures = self.record_failures
             logger.warning(
                 "record %d (%s) failed after %d attempts: %r — dropped "
                 "(%d/%d failure budget)",
                 i, rec.get("image"), self.retry.tries, e,
-                self.record_failures, self.failure_budget,
+                failures, self.failure_budget,
             )
-            if self.record_failures > self.failure_budget:
+            if failures > self.failure_budget:
                 raise LoaderFaultBudgetExceeded(
-                    f"{self.record_failures} records failed to load "
+                    f"{failures} records failed to load "
                     f"(budget {self.failure_budget}); latest: record {i} "
                     f"({rec.get('image')}): {e!r}"
                 ) from e
@@ -450,7 +557,8 @@ class TrainLoader:
             images = [self._load_guarded(i) for i in idxs]
             good = [(i, im) for i, im in zip(idxs, images) if im is not None]
             if not good:
-                self.dropped_batches += 1
+                with self._fault_lock:
+                    self.dropped_batches += 1
                 logger.warning(
                     "dropping whole batch %s — no loadable record", idxs
                 )
@@ -462,7 +570,8 @@ class TrainLoader:
             for i, im in zip(idxs, images):
                 if im is None:
                     i, im = good[0]
-                    self.substituted_records += 1
+                    with self._fault_lock:
+                        self.substituted_records += 1
                 filled.append(i)
                 imgs.append(im)
             return make_batch(
@@ -471,6 +580,24 @@ class TrainLoader:
                 with_masks=self.cfg.network.USE_MASK,
             )
 
+        workers = (
+            default_assembly_workers() if self.assembly_workers is None
+            else max(0, int(self.assembly_workers))
+        )
+        if workers > 0:
+            # parallel assembly: ``build`` is pure per plan entry (its
+            # only shared state — render/prepared LRUs, fault counters —
+            # is locked), so the ordered pool stream is bit-identical to
+            # the serial one for the same seed; the pool's run-ahead
+            # window doubles as the prefetch stage
+            pool = AssemblyPool(workers, name="train-assembly")
+            return _AssembledStream(
+                pool,
+                pool.imap(
+                    lambda entry: build(*entry), plan,
+                    window=max(self.prefetch, workers + 2),
+                ),
+            )
         source = (
             batch
             for bucket, idxs in plan
@@ -522,11 +649,19 @@ class TestLoader:
             )
             yield rec, batch
 
-    def iter_batched(self, prefetch: int = 2):
+    def iter_batched(
+        self, prefetch: int = 2, assembly_workers: Optional[int] = None
+    ):
         """Yields ``(dataset_indices, records, batch)``; a background
         thread overlaps host image assembly with the consumer's device
         forward + fetch (same prefetcher discipline as TrainLoader —
-        host decode/resize is the eval bottleneck, not the TPU)."""
+        host decode/resize is the eval bottleneck, not the TPU).
+
+        ``assembly_workers`` (None → ``MX_RCNN_ASSEMBLY_WORKERS``,
+        default 0): > 0 assembles batches concurrently in an
+        :class:`~mx_rcnn_tpu.data.assembler.AssemblyPool` instead of the
+        single prefetch thread — same yield order and bit-identical
+        batches, ``stats()`` on the returned stream reports occupancy."""
         groups: Dict[Tuple[int, int], List[int]] = {}
         for i, rec in enumerate(self.roidb):
             b = _orientation_bucket(rec, self.cfg.SHAPE_BUCKETS)
@@ -548,5 +683,18 @@ class TestLoader:
             )
             return chunk, recs, batch
 
+        workers = (
+            default_assembly_workers() if assembly_workers is None
+            else max(0, int(assembly_workers))
+        )
+        if workers > 0:
+            pool = AssemblyPool(workers, name="test-assembly")
+            return _AssembledStream(
+                pool,
+                pool.imap(
+                    lambda entry: build(*entry), plan,
+                    window=max(prefetch, workers + 2),
+                ),
+            )
         source = (build(bucket, chunk) for bucket, chunk in plan)
         return PrefetchIterator(source, prefetch)

@@ -48,3 +48,17 @@ def mask_to_rle(mask_prob: np.ndarray, box: np.ndarray, h: int, w: int,
     from mx_rcnn_tpu.native import rle
 
     return rle.encode(paste_mask(mask_prob, box, h, w, thresh))
+
+
+def rles_for_detections(
+    mask_probs: np.ndarray, dets: np.ndarray, h: int, w: int,
+    thresh: float = 0.5,
+) -> list:
+    """One class's (n, S, S) probability grids + (n, 5) detections →
+    list of image-space RLEs.  The unit of completion-pool work in
+    ``pred_eval``: paste + threshold + RLE-encode dominates segm eval
+    host cost, and this whole list is independent per (image, class)."""
+    return [
+        mask_to_rle(p, d[:4], h, w, thresh)
+        for p, d in zip(mask_probs, dets)
+    ]

@@ -16,8 +16,11 @@ from separate threads DO overlap, the GIL dropping during relay I/O):
     in PR 1's :class:`~mx_rcnn_tpu.core.resilience.RetryPolicy` — a
     transient device/relay fault retries the whole batch
     deterministically), then per-request detections + future resolution.
-    A semaphore keeps the assembler at most ``in_flight`` batches ahead,
-    so device-side queueing stays bounded too.
+    The workers live in a bounded
+    :class:`~mx_rcnn_tpu.data.assembler.CompletionPool` whose blocking
+    submit keeps the assembler at most ``in_flight`` batches ahead, so
+    device-side queueing stays bounded too — and whose counters land in
+    :meth:`ServingEngine.snapshot`.
 
 Every request resolves exactly once: detections list, or
 :class:`DeadlineExceeded` / :class:`QueueFull` /
@@ -29,12 +32,13 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import Future
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from mx_rcnn_tpu.core.resilience import RetryPolicy
+from mx_rcnn_tpu.data.assembler import CompletionPool
 from mx_rcnn_tpu.serve.batcher import DynamicBatcher, QueueFull, Request
 from mx_rcnn_tpu.serve.metrics import ServeMetrics
 from mx_rcnn_tpu.serve.runner import ServeRunner
@@ -62,8 +66,7 @@ class ServingEngine:
         self.metrics = ServeMetrics()
         self.retry = retry if retry is not None else RetryPolicy(tries=3)
         self._in_flight = max(1, int(in_flight))
-        self._sem = threading.Semaphore(self._in_flight)
-        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool: Optional[CompletionPool] = None
         self._assembler: Optional[threading.Thread] = None
         self._started = False
 
@@ -73,8 +76,11 @@ class ServingEngine:
             return self
         if warmup:
             self.runner.warmup()
-        self._pool = ThreadPoolExecutor(
-            max_workers=self._in_flight, thread_name_prefix="serve-complete"
+        # same thread layout as before (in_flight workers, submit blocks
+        # at depth=in_flight — the old semaphore), but the pool exports
+        # the shared data-plane counters into snapshot()
+        self._pool = CompletionPool(
+            self._in_flight, depth=self._in_flight, name="serve-complete"
         )
         self._assembler = threading.Thread(
             target=self._assemble_loop, name="serve-assemble", daemon=True
@@ -89,7 +95,9 @@ class ServingEngine:
             return
         self.batcher.close()
         self._assembler.join()
-        self._pool.shutdown(wait=True)
+        # raise_errors=False: request futures already carry per-request
+        # failures; an engine drain must not re-raise them at shutdown
+        self._pool.close(raise_errors=False)
         self._started = False
 
     def __enter__(self) -> "ServingEngine":
@@ -146,45 +154,48 @@ class ServingEngine:
             if not live:
                 continue
             batch = self.runner.assemble(live)
-            self._sem.acquire()  # at most in_flight batches on the device
+            # pool submit blocks at depth=in_flight: at most in_flight
+            # batches on the device (the old explicit semaphore)
             self._pool.submit(self._complete, live, batch)
 
     def _complete(
         self, reqs: List[Request], batch: Dict[str, np.ndarray]
     ) -> None:
+        # runs on a completion-pool worker; the pool's depth slot is
+        # released when this returns, unblocking the assembler
+        t0 = time.monotonic()
+
+        def attempt_run(attempt: int):
+            if attempt:
+                self.metrics.inc("retried")
+            return self.runner.run(batch)
+
         try:
-            t0 = time.monotonic()
-
-            def attempt_run(attempt: int):
-                if attempt:
-                    self.metrics.inc("retried")
-                return self.runner.run(batch)
-
+            out = self.retry.run(attempt_run)
+        except Exception as e:
+            self.metrics.inc("failed", len(reqs))
+            for r in reqs:
+                r.future.set_exception(e)
+            return
+        done = time.monotonic()
+        self.metrics.service.record(done - t0)
+        self.metrics.record_batch(len(reqs), self.runner.max_batch)
+        for k, r in enumerate(reqs):
             try:
-                out = self.retry.run(attempt_run)
-            except Exception as e:
-                self.metrics.inc("failed", len(reqs))
-                for r in reqs:
-                    r.future.set_exception(e)
-                return
-            done = time.monotonic()
-            self.metrics.service.record(done - t0)
-            self.metrics.record_batch(len(reqs), self.runner.max_batch)
-            for k, r in enumerate(reqs):
-                try:
-                    dets = self.runner.detections_for(
-                        out, batch, k, orig_hw=r.orig_hw
-                    )
-                except Exception as e:  # postprocess bug: fail this request
-                    self.metrics.inc("failed")
-                    r.future.set_exception(e)
-                    continue
-                self.metrics.inc("completed")
-                self.metrics.e2e.record(time.monotonic() - r.enqueue_t)
-                r.future.set_result(dets)
-        finally:
-            self._sem.release()
+                dets = self.runner.detections_for(
+                    out, batch, k, orig_hw=r.orig_hw
+                )
+            except Exception as e:  # postprocess bug: fail this request
+                self.metrics.inc("failed")
+                r.future.set_exception(e)
+                continue
+            self.metrics.inc("completed")
+            self.metrics.e2e.record(time.monotonic() - r.enqueue_t)
+            r.future.set_result(dets)
 
     # ---------------------------------------------------------- reporting
     def snapshot(self) -> Dict:
-        return self.metrics.snapshot(self.runner.compile_cache)
+        out = self.metrics.snapshot(self.runner.compile_cache)
+        if self._pool is not None:
+            out["completion"] = self._pool.stats()
+        return out

@@ -30,7 +30,12 @@ import numpy as np
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.core.tester import Predictor, im_detect
-from mx_rcnn_tpu.data.image import normalize, pad_to_bucket, resize_im
+from mx_rcnn_tpu.data.image import (
+    normalize,
+    pad_to_bucket,
+    quantize_uint8,
+    resize_im,
+)
 from mx_rcnn_tpu.native.hostops import nms_host
 from mx_rcnn_tpu.serve.batcher import Request
 from mx_rcnn_tpu.serve.buckets import BucketLadder, CompileCache
@@ -132,7 +137,7 @@ def prepare_request(
     h, w = im.shape[:2]
     bucket = ladder.select(h, w)  # raises BucketOverflow
     if cfg.TEST.UINT8_TRANSFER:
-        im = np.clip(np.rint(im), 0, 255).astype(np.uint8)
+        im = quantize_uint8(im)
     else:
         im = normalize(im, cfg.network.PIXEL_MEANS, cfg.network.PIXEL_STDS)
     return Request(

@@ -106,3 +106,34 @@ def test_lr_boundary_transient_is_bounded_and_decays():
     ratios = step_gaps[1:] / step_gaps[:-1]
     np.testing.assert_allclose(ratios, m, rtol=1e-3)
     assert abs(step_gaps[-1]) < 0.02 * abs(step_gaps[0])
+
+
+def test_make_lr_schedule_boundary_mapping():
+    """The epoch-denominated LR_STEP_EPOCHS land on exact step
+    boundaries: base lr through step ``e·steps_per_epoch − 1``, and the
+    LR_FACTOR drop applies AT the boundary step itself (the schedule is
+    queried with the pre-increment step counter, so boundary step B is
+    the first step that TRAINS at the reduced lr — the regime the
+    transient test above characterizes)."""
+    from mx_rcnn_tpu.core.train import make_lr_schedule
+
+    cfg = _cfg()
+    cfg = cfg.replace(
+        TRAIN=dataclasses.replace(
+            cfg.TRAIN, LEARNING_RATE=0.02, LR_STEP_EPOCHS=(2, 5),
+            LR_FACTOR=0.1,
+        )
+    )
+    steps_per_epoch = 37
+    sched = make_lr_schedule(cfg, steps_per_epoch)
+    base = cfg.TRAIN.LEARNING_RATE
+    b1, b2 = 2 * steps_per_epoch, 5 * steps_per_epoch
+    np.testing.assert_allclose(float(sched(0)), base, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(b1 - 1)), base, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(b1)), base * 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(b2 - 1)), base * 0.1, rtol=1e-6)
+    # factors compound across boundaries (MultiFactorScheduler semantics)
+    np.testing.assert_allclose(float(sched(b2)), base * 0.01, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(sched(b2 + 10 * steps_per_epoch)), base * 0.01, rtol=1e-6
+    )
