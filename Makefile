@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast resilience bench bench-eval eval-bench serve pipeline integration-gate clean-native
+.PHONY: native test test-kernels test-fast resilience bench bench-eval eval-bench serve serve-fault pipeline integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -69,6 +69,17 @@ eval-bench:
 # zero recompiles after warmup, as JSON lines + the artifact file
 serve:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve --out BENCH_serve_cpu.json
+
+# fault-matrix serving bench (ISSUE 6): the same deterministic load
+# against a 3-replica health-gated pool under healthy / wedged-replica /
+# flapping-replica MX_RCNN_FAULTS scenarios; emits per-scenario p50/p99
+# + throughput, drain->rewarm->rejoin recovery time, shed/hedge/requeue
+# counts, and the zero-lost + byte-identical evidence, as JSON lines +
+# the BENCH_serve_fault_cpu.json artifact
+serve-fault:
+	JAX_PLATFORMS=cpu $(PY) bench.py --serve_fault --serve_requests 24 \
+	      --serve_concurrency 6 --serve_max_batch 2 \
+	      --out BENCH_serve_fault_cpu.json
 
 # device-resident step pipeline bench (ISSUE 4): feed occupancy, fetch
 # stalls, K=1 byte-identical check on the CPU smoke config; emits JSON

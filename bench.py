@@ -131,29 +131,17 @@ def bench_one(
     }
 
 
-def bench_serve(
-    network: str,
-    requests: int,
-    concurrency: int,
-    max_batch: int,
-    linger_ms: float,
-    small: bool = True,
-) -> tuple:
-    """Online-serving measurement: drive the dynamic-batching engine with
-    the deterministic synthetic load generator and report latency,
-    throughput, occupancy, and the compile count that proves the shape
-    ladder held (misses == len(ladder), and not one more).
-
-    → (records, report): the per-metric JSON-line records plus the full
-    engine snapshot for the artifact.  Serving has no reference baseline
-    (the MXNet repo had no online path), so ``vs_baseline`` is null.
-    """
+def _serve_model(network: str, small: bool, max_batch: int,
+                 deterministic: bool = False):
+    """Shared serve-bench setup → (model, params, cfg, sizes, factory).
+    ``factory`` builds one device-pinned ServeRunner per replica index —
+    the ReplicaPool's runner source (and what a rewarm re-invokes)."""
     import jax
 
     from mx_rcnn_tpu.config import generate_config
     from mx_rcnn_tpu.models import build_model
-    from mx_rcnn_tpu.serve.engine import ServingEngine
-    from mx_rcnn_tpu.serve.loadgen import DEFAULT_SIZES, run_load
+    from mx_rcnn_tpu.serve.loadgen import DEFAULT_SIZES
+    from mx_rcnn_tpu.serve.router import make_replica_factory
     from mx_rcnn_tpu.serve.runner import ServeRunner
     from mx_rcnn_tpu.tools.serve import small_config
 
@@ -171,12 +159,51 @@ def bench_serve(
         np.array([[h, w, 1.0]], np.float32),
         train=False,
     )["params"]
-    runner = ServeRunner(model, params, cfg, max_batch=max_batch)
-    with ServingEngine(runner, max_linger=linger_ms / 1000.0) as engine:
+    factory = make_replica_factory(
+        lambda params: ServeRunner(
+            model, params, cfg, max_batch=max_batch,
+            deterministic=deterministic,
+        ),
+        params,
+    )
+    return model, params, cfg, sizes, factory
+
+
+def bench_serve(
+    network: str,
+    requests: int,
+    concurrency: int,
+    max_batch: int,
+    linger_ms: float,
+    small: bool = True,
+    replicas: int = 1,
+) -> tuple:
+    """Online-serving measurement: drive the dynamic-batching engine with
+    the deterministic synthetic load generator and report latency,
+    throughput, occupancy, and the compile count that proves the shape
+    ladder held (misses == len(ladder), and not one more).
+
+    → (records, report): the per-metric JSON-line records plus the full
+    engine snapshot for the artifact.  Serving has no reference baseline
+    (the MXNet repo had no online path), so ``vs_baseline`` is null.
+
+    Routing always goes through the :class:`ReplicaPool` (ISSUE 6) —
+    ``replicas=1`` is the no-regression case the committed
+    ``BENCH_serve_cpu.json`` pins (same compile-miss invariant through
+    the pool's merged cache view).
+    """
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.loadgen import run_load
+    from mx_rcnn_tpu.serve.router import ReplicaPool
+
+    _, _, _, sizes, factory = _serve_model(network, small, max_batch)
+    pool = ReplicaPool(factory, n_replicas=replicas)
+    with ServingEngine(pool, max_linger=linger_ms / 1000.0) as engine:
         report = run_load(
             engine, num_requests=requests, concurrency=concurrency,
             sizes=sizes, seed=0,
         )
+    pool.close()
     eng = report["engine"]
     tag = _METRIC_NAMES[network].replace("_e2e", "")
     records = [
@@ -212,6 +239,200 @@ def bench_serve(
         },
     ]
     return records, report
+
+
+# serve-fault scenario grid: one MX_RCNN_FAULTS spec per scenario.
+# Ordinal 0 on every replica is its initial warmup probe, so injected
+# ordinals start at 1 to land on live traffic, not warmup.
+_FAULT_SCENARIOS = {
+    # clean pool: the reference run the faulted runs are diffed against
+    "healthy": "",
+    # hard wedge past the stall watchdog on replica 1: trips DRAINING,
+    # the in-flight batch requeues, the replica rewarms and rejoins
+    "wedged": "replica_wedge@1.3:10",
+    # replica 2 flaps: four consecutive dispatches/probes fail, tripping
+    # the breaker twice (backoff doubling) before the pool readmits it
+    "flapping": ("predict_fail@2.1,predict_fail@2.2,"
+                 "predict_fail@2.3,predict_fail@2.4"),
+}
+
+
+def _recovery_s(pool_snap: dict) -> float:
+    """Max DRAINING→HEALTHY-rejoin span across replicas, from the
+    transition log (None when nothing tripped)."""
+    spans = []
+    for rep in pool_snap.get("replicas", []):
+        drain_t = None
+        for tr in rep["transitions"]:
+            if tr["to"] == "draining" and drain_t is None:
+                drain_t = tr["t"]
+            elif drain_t is not None and tr["to"] == "healthy":
+                spans.append(tr["t"] - drain_t)
+                drain_t = None
+    return round(max(spans), 3) if spans else None
+
+
+def bench_serve_fault(
+    network: str,
+    requests: int,
+    concurrency: int,
+    max_batch: int,
+    linger_ms: float,
+    replicas: int = 3,
+    small: bool = True,
+) -> tuple:
+    """Fault-matrix serving bench: the same deterministic load against a
+    ≥3-replica pool under each ``_FAULT_SCENARIOS`` spec.
+
+    Proves the ISSUE 6 acceptance criteria outside the unit suite: zero
+    lost requests under every scenario (ok + deadline + error ==
+    submitted), detections byte-identical to the healthy run for every
+    index that succeeded in both, and the wedged replica's
+    drain→rewarm→rejoin visible as a measured recovery time.  Runners
+    are built ``deterministic=True`` so cross-replica results are
+    bitwise comparable on CPU (the thunk runtime reassociates reductions
+    otherwise).
+    """
+    import os
+
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.loadgen import run_load
+    from mx_rcnn_tpu.serve.replica import HealthPolicy
+    from mx_rcnn_tpu.serve.router import ReplicaPool
+    from mx_rcnn_tpu.utils import faults
+
+    replicas = max(3, replicas)
+    _, _, _, sizes, factory = _serve_model(
+        network, small, max_batch, deterministic=True
+    )
+    # timeouts sized to CPU service times (~1-3 s/batch on the small
+    # config): hedge before the watchdog, watchdog well under the wedge
+    policy = HealthPolicy(stall_timeout=6.0, breaker_backoff=0.25,
+                          breaker_max_backoff=4.0)
+    scenarios = {}
+    baseline_ok = None
+    prior = os.environ.get(faults.ENV_VAR)
+    try:
+        for name, spec in _FAULT_SCENARIOS.items():
+            if spec:
+                os.environ[faults.ENV_VAR] = spec
+            else:
+                os.environ.pop(faults.ENV_VAR, None)
+            faults.reset()
+            pool = ReplicaPool(
+                factory, n_replicas=replicas, policy=policy,
+                hedge_timeout=3.0,
+            )
+            engine = ServingEngine(
+                pool, max_linger=linger_ms / 1000.0, in_flight=replicas
+            )
+            with engine:
+                report = run_load(
+                    engine, num_requests=requests,
+                    concurrency=concurrency, sizes=sizes, seed=0,
+                    collect=True,
+                )
+            # A tripped replica's drain→recompile→rewarm→rejoin usually
+            # outlives the load itself on CPU (rewarm recompiles the
+            # whole ladder), so wait it out — bounded — before the final
+            # snapshot; otherwise recovery_s is null, not measured.
+            if spec:
+                t_wait = time.time()
+                while time.time() - t_wait < 120.0:
+                    reps = pool.snapshot()["replicas"]
+                    tripped = any(
+                        tr["to"] == "draining"
+                        for r in reps for tr in r["transitions"]
+                    )
+                    if tripped and all(
+                        r["state"] == "healthy" for r in reps
+                    ):
+                        break
+                    if not tripped and time.time() - t_wait > 20.0:
+                        break  # fault never fired this run
+                    time.sleep(0.5)
+            pool_snap = pool.snapshot()
+            pool.close()
+            results = report.pop("_results")
+            ok = {i: r for i, (kind, r) in results.items() if kind == "ok"}
+            if name == "healthy":
+                baseline_ok = ok
+                identical = True
+            else:
+                identical = all(
+                    _dets_equal(baseline_ok[i], ok[i])
+                    for i in ok if i in baseline_ok
+                )
+            out = report["outcomes"]
+            resolved = out["ok"] + out["deadline"] + out["error"]
+            scenarios[name] = {
+                "spec": spec,
+                "p50_ms": report["engine"]["latency"]["e2e"]["p50_ms"],
+                "p99_ms": report["engine"]["latency"]["e2e"]["p99_ms"],
+                "imgs_per_sec": report["imgs_per_sec"],
+                "outcomes": out,
+                "lost_requests": requests - resolved,
+                "detections_match_healthy": identical,
+                "recovery_s": _recovery_s(pool_snap),
+                "shed": report["engine"]["requests"]["shed"],
+                "routing": pool_snap["routing"],
+                "transitions": {
+                    rep["index"]: rep["transitions"]
+                    for rep in pool_snap["replicas"]
+                },
+            }
+    finally:
+        if prior is None:
+            os.environ.pop(faults.ENV_VAR, None)
+        else:
+            os.environ[faults.ENV_VAR] = prior
+        faults.reset()
+
+    tag = _METRIC_NAMES[network].replace("_e2e", "")
+    records = []
+    for name, s in scenarios.items():
+        records.append({
+            "metric": f"serve_fault_{name}_p99_ms_{tag}",
+            "value": s["p99_ms"], "unit": "ms", "vs_baseline": None,
+        })
+        records.append({
+            "metric": f"serve_fault_{name}_lost_requests_{tag}",
+            "value": s["lost_requests"], "unit": "requests",
+            "vs_baseline": None,
+        })
+    records.append({
+        "metric": f"serve_fault_wedged_recovery_s_{tag}",
+        "value": scenarios["wedged"]["recovery_s"], "unit": "seconds",
+        "vs_baseline": None,
+    })
+    records.append({
+        "metric": f"serve_fault_detections_match_{tag}",
+        "value": int(all(
+            s["detections_match_healthy"] for s in scenarios.values()
+        )),
+        "unit": "bool", "vs_baseline": None,
+    })
+    report = {
+        "replicas": replicas,
+        "requests": requests,
+        "concurrency": concurrency,
+        "policy": {"stall_timeout": policy.stall_timeout,
+                   "hedge_timeout": 3.0,
+                   "breaker_backoff": policy.breaker_backoff},
+        "scenarios": scenarios,
+    }
+    return records, report
+
+
+def _dets_equal(a, b) -> bool:
+    """Per-class detection lists compare bitwise."""
+    if len(a) != len(b):
+        return False
+    return all(
+        np.asarray(x).shape == np.asarray(y).shape
+        and np.asarray(x).tobytes() == np.asarray(y).tobytes()
+        for x, y in zip(a, b)
+    )
 
 
 def _smoke_config(batch_images: int):
@@ -448,6 +669,15 @@ def main():
     ap.add_argument("--serve_concurrency", type=int, default=16)
     ap.add_argument("--serve_max_batch", type=int, default=4)
     ap.add_argument("--serve_linger_ms", type=float, default=25.0)
+    ap.add_argument("--serve_replicas", type=int, default=1,
+                    help="replica-pool size for --serve (1 = the "
+                         "no-regression case) / --serve_fault (min 3)")
+    ap.add_argument(
+        "--serve_fault", action="store_true",
+        help="fault-matrix serving bench: healthy vs wedged vs flapping "
+             "replica scenarios on a >=3-replica pool (zero-lost + "
+             "byte-identical + recovery-time evidence)",
+    )
     ap.add_argument(
         "--serve_full", action="store_true",
         help="serve at the full config (default: tiny CPU-runnable one)",
@@ -519,12 +749,26 @@ def main():
                 json.dump({"records": records, "report": report}, f, indent=1)
         return
 
+    if args.serve_fault:
+        network = "resnet50" if args.network == "resnet" else args.network
+        records, report = bench_serve_fault(
+            network, args.serve_requests, args.serve_concurrency,
+            args.serve_max_batch, args.serve_linger_ms,
+            replicas=args.serve_replicas, small=not args.serve_full,
+        )
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
+
     if args.serve:
         network = "resnet50" if args.network == "resnet" else args.network
         records, report = bench_serve(
             network, args.serve_requests, args.serve_concurrency,
             args.serve_max_batch, args.serve_linger_ms,
-            small=not args.serve_full,
+            small=not args.serve_full, replicas=args.serve_replicas,
         )
         for rec in records:
             print(json.dumps(rec), flush=True)

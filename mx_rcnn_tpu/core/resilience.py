@@ -97,6 +97,31 @@ class RetryPolicy:
                     time.sleep(self.delay * self.backoff**attempt)
 
 
+# The one retry tuning surface (ISSUE 6 satellite): every bounded-retry
+# site — the loader's record reads, the serving engine's single-runner
+# batch retry, and a pool replica's in-place predict retry — constructs
+# its policy here, so serve and train faults share one set of constants
+# instead of the per-module literals they used to duplicate.
+#
+# "replica" is deliberately tighter than "serve": a pooled dispatch that
+# keeps failing should fail over to ANOTHER replica (the router's job)
+# rather than burn its latency budget retrying in place.
+RETRY_PRESETS: Dict[str, RetryPolicy] = {
+    "loader": RetryPolicy(tries=3, delay=0.0),
+    "serve": RetryPolicy(tries=3, delay=0.0),
+    "replica": RetryPolicy(tries=2, delay=0.0),
+}
+
+
+def make_retry_policy(kind: str, **overrides) -> RetryPolicy:
+    """Preset :class:`RetryPolicy` by site kind, with per-call field
+    overrides (``make_retry_policy("replica", tries=1)``)."""
+    import dataclasses
+
+    base = RETRY_PRESETS[kind]
+    return dataclasses.replace(base, **overrides) if overrides else base
+
+
 class StepWatchdog:
     """Wall-clock guard for a single train step.
 

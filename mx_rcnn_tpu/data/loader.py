@@ -29,7 +29,7 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
-from mx_rcnn_tpu.core.resilience import RetryPolicy
+from mx_rcnn_tpu.core.resilience import RetryPolicy, make_retry_policy
 from mx_rcnn_tpu.data.assembler import AssemblyPool, default_assembly_workers
 from mx_rcnn_tpu.data.image import load_image, pick_bucket, prepare_image
 from mx_rcnn_tpu.utils import faults
@@ -473,7 +473,7 @@ class TrainLoader:
         # batches already trained this epoch (the plan is deterministic
         # per (seed, epoch), so skipping reproduces the exact stream)
         self.skip_batches = 0
-        self.retry = retry or RetryPolicy(tries=3, delay=0.0)
+        self.retry = retry or make_retry_policy("loader")
         # default budget: 1% of the roidb, floored so tiny smoke runs
         # aren't aborted by a single flaky read
         self.failure_budget = (

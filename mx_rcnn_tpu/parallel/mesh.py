@@ -45,6 +45,24 @@ def make_mesh(
     return Mesh(devices.reshape(n_data, n_model), ("data", "model"))
 
 
+def replica_slices(n_replicas: Optional[int] = None, devices=None) -> list:
+    """Device assignment for a serving replica pool: replica i runs on
+    ``slices[i % len(slices)]``.
+
+    Serving replication is the transpose of the training mesh: training
+    shards ONE batch across all devices, a replica pool pins ONE
+    independent predictor per device (params committed via
+    ``jax.device_put(params, device)``, so every jit it traces executes
+    there).  With ``n_replicas`` ≤ device count each replica owns a
+    device exclusively; beyond that they round-robin share (the CPU test
+    topology: 8 virtual devices, pools of any size).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_replicas is None or n_replicas >= len(devs):
+        return devs
+    return devs[:n_replicas]
+
+
 def replicate(tree, mesh: Mesh):
     """Replicate a pytree (params/opt state) across the mesh."""
     sharding = NamedSharding(mesh, P())

@@ -6,7 +6,12 @@ dynamic micro-batcher (``batcher``), and one canonical predict path
 (``runner``) shared with ``core/tester.py`` and ``tools/demo.py``;
 ``engine`` wires them into a threaded serving loop with per-request
 retry, and ``metrics``/``loadgen`` provide latency observability and a
-deterministic synthetic driver.  See SERVING.md for the architecture.
+deterministic synthetic driver.  ISSUE 6 adds fault tolerance at fleet
+scale: ``replica`` wraps one runner in a health-gated state machine
+(WARMING → HEALTHY → DEGRADED → DRAINING → RECOVERING) and ``router``
+pools N of them behind the same engine intake with least-loaded
+bucket-affine dispatch, hedging, requeue-never-drop, and load shedding.
+See SERVING.md for the architecture and failure semantics.
 """
 
 from mx_rcnn_tpu.serve.batcher import DynamicBatcher, QueueFull, Request
@@ -15,8 +20,19 @@ from mx_rcnn_tpu.serve.buckets import (
     BucketOverflow,
     CompileCache,
 )
-from mx_rcnn_tpu.serve.engine import DeadlineExceeded, ServingEngine
+from mx_rcnn_tpu.serve.engine import (
+    DeadlineExceeded,
+    EngineStopped,
+    ServingEngine,
+)
 from mx_rcnn_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+from mx_rcnn_tpu.serve.replica import (
+    HealthPolicy,
+    Replica,
+    ReplicaDrained,
+    ReplicaState,
+)
+from mx_rcnn_tpu.serve.router import NoHealthyReplica, ReplicaPool
 from mx_rcnn_tpu.serve.runner import ServeRunner
 
 __all__ = [
@@ -25,8 +41,15 @@ __all__ = [
     "CompileCache",
     "DeadlineExceeded",
     "DynamicBatcher",
+    "EngineStopped",
+    "HealthPolicy",
     "LatencyHistogram",
+    "NoHealthyReplica",
     "QueueFull",
+    "Replica",
+    "ReplicaDrained",
+    "ReplicaPool",
+    "ReplicaState",
     "Request",
     "ServeMetrics",
     "ServeRunner",

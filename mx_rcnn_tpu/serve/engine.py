@@ -25,19 +25,28 @@ from separate threads DO overlap, the GIL dropping during relay I/O):
 Every request resolves exactly once: detections list, or
 :class:`DeadlineExceeded` / :class:`QueueFull` /
 :class:`~mx_rcnn_tpu.serve.buckets.BucketOverflow` / the predict error
-after retries are exhausted.
+after retries are exhausted / :class:`EngineStopped` when the engine is
+torn down first (``stop`` sweeps the live-request registry, so a
+submitter can never block forever on a dead engine).
+
+The runner may also be a :class:`~mx_rcnn_tpu.serve.router.ReplicaPool`
+(detected by its ``replicas`` attribute): the engine then passes each
+batch's tightest deadline to ``run`` and disables its own RetryPolicy —
+retry, hedging, and failover belong to the pool — and ``submit`` sheds
+load early (``QueueFull`` + ``shed`` counter) when the pool's healthy
+fraction scales the effective queue capacity below the current backlog.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Dict, List, Optional
 
 import numpy as np
 
-from mx_rcnn_tpu.core.resilience import RetryPolicy
+from mx_rcnn_tpu.core.resilience import RetryPolicy, make_retry_policy
 from mx_rcnn_tpu.data.assembler import CompletionPool
 from mx_rcnn_tpu.serve.batcher import DynamicBatcher, QueueFull, Request
 from mx_rcnn_tpu.serve.metrics import ServeMetrics
@@ -46,6 +55,12 @@ from mx_rcnn_tpu.serve.runner import ServeRunner
 
 class DeadlineExceeded(RuntimeError):
     """The request's deadline passed before the device could run it."""
+
+
+class EngineStopped(RuntimeError):
+    """The engine was torn down before this request completed — a
+    terminal resolution, so no submitter is ever left blocked on a
+    future the engine will never touch again."""
 
 
 class ServingEngine:
@@ -64,11 +79,19 @@ class ServingEngine:
             runner.max_batch, max_linger=max_linger, max_queue=max_queue
         )
         self.metrics = ServeMetrics()
-        self.retry = retry if retry is not None else RetryPolicy(tries=3)
+        self.retry = retry if retry is not None else make_retry_policy("serve")
         self._in_flight = max(1, int(in_flight))
         self._pool: Optional[CompletionPool] = None
         self._assembler: Optional[threading.Thread] = None
         self._started = False
+        # a ReplicaPool routes/retries/hedges internally; the engine then
+        # skips its own RetryPolicy and sheds early on pool health
+        self._routed = hasattr(runner, "replicas")
+        self._aborting = False
+        # every not-yet-resolved request, so stop() can sweep leftovers
+        # with a terminal EngineStopped instead of stranding submitters
+        self._live: Dict[int, Request] = {}
+        self._live_lock = threading.Lock()
 
     # ---------------------------------------------------------- lifecycle
     def start(self, warmup: bool = True) -> "ServingEngine":
@@ -89,16 +112,34 @@ class ServingEngine:
         self._assembler.start()
         return self
 
-    def stop(self) -> None:
-        """Drain: stop accepting, finish queued work, join threads."""
+    def stop(self, drain: bool = True) -> None:
+        """Stop accepting and join threads.  ``drain=True`` finishes
+        queued work first; ``drain=False`` aborts — queued batches are
+        failed instead of dispatched.  Either way every still-pending
+        future is resolved (terminal :class:`EngineStopped`) before this
+        returns: no submitter is left blocked on a dead engine."""
         if not self._started:
             return
+        if not drain:
+            self._aborting = True
         self.batcher.close()
-        self._assembler.join()
+        if self._assembler is not None:
+            self._assembler.join()
         # raise_errors=False: request futures already carry per-request
         # failures; an engine drain must not re-raise them at shutdown
-        self._pool.close(raise_errors=False)
+        if self._pool is not None:
+            self._pool.close(raise_errors=False)
         self._started = False
+        with self._live_lock:
+            leftovers = list(self._live.values())
+            self._live.clear()
+        stopped = EngineStopped("engine stopped before request completed")
+        for r in leftovers:
+            try:
+                r.future.set_exception(stopped)
+            except InvalidStateError:
+                continue
+            self.metrics.inc("stopped")
 
     def __enter__(self) -> "ServingEngine":
         return self.start()
@@ -117,6 +158,20 @@ class ServingEngine:
         synchronously — both count as ``rejected``."""
         if not self._started:
             raise RuntimeError("engine not started")
+        if self._routed:
+            # load shedding: scale the effective intake capacity by the
+            # pool's healthy fraction — when half the replicas are out,
+            # rejecting at half queue depth beats queueing work the pool
+            # cannot clear before its deadlines
+            frac = self.runner.healthy_fraction()
+            cap = max(1, int(self.batcher.max_queue * frac))
+            if frac == 0.0 or self.batcher.pending() >= cap:
+                self.metrics.inc("shed")
+                self.metrics.inc("rejected")
+                raise QueueFull(
+                    f"shedding load: healthy fraction {frac:.2f}, "
+                    f"effective queue capacity {cap if frac else 0}"
+                )
         deadline = (
             time.monotonic() + deadline_s if deadline_s is not None else None
         )
@@ -126,26 +181,51 @@ class ServingEngine:
         except Exception:
             self.metrics.inc("rejected")
             raise
+        with self._live_lock:
+            self._live[id(req)] = req
         self.metrics.inc("submitted")
         self.metrics.record_queue_depth(self.batcher.pending())
         return req.future
 
     # ------------------------------------------------------------- device
+    def _resolve(self, req: Request, result=None,
+                 exc: Optional[BaseException] = None) -> bool:
+        """Resolve one request exactly once and retire it from the live
+        registry; False when it already resolved elsewhere (e.g. swept
+        by a concurrent ``stop``)."""
+        with self._live_lock:
+            self._live.pop(id(req), None)
+        try:
+            if exc is not None:
+                req.future.set_exception(exc)
+            else:
+                req.future.set_result(result)
+            return True
+        except InvalidStateError:
+            return False
+
     def _assemble_loop(self) -> None:
         while True:
             batch_reqs = self.batcher.next_batch()
             if batch_reqs is None:
                 return
+            if self._aborting:
+                stopped = EngineStopped("engine aborted before dispatch")
+                for r in batch_reqs:
+                    if self._resolve(r, exc=stopped):
+                        self.metrics.inc("stopped")
+                continue
             now = time.monotonic()
             live: List[Request] = []
             for r in batch_reqs:
                 if r.expired(now):
                     self.metrics.inc("expired")
-                    r.future.set_exception(
-                        DeadlineExceeded(
+                    self._resolve(
+                        r,
+                        exc=DeadlineExceeded(
                             f"deadline passed {now - r.deadline:.3f}s before "
                             f"device pickup"
-                        )
+                        ),
                     )
                 else:
                     self.metrics.queue_wait.record(r.picked_t - r.enqueue_t)
@@ -171,31 +251,54 @@ class ServingEngine:
             return self.runner.run(batch)
 
         try:
-            out = self.retry.run(attempt_run)
+            if self._routed:
+                # the pool retries/hedges/fails-over internally — the
+                # engine's own RetryPolicy would rerun an already-hedged
+                # batch; the tightest live deadline drives the hedge
+                deadlines = [r.deadline for r in reqs if r.deadline is not None]
+                out = self.runner.run(
+                    batch, deadline=min(deadlines) if deadlines else None
+                )
+            else:
+                out = self.retry.run(attempt_run)
         except Exception as e:
             self.metrics.inc("failed", len(reqs))
             for r in reqs:
-                r.future.set_exception(e)
+                self._resolve(r, exc=e)
             return
         done = time.monotonic()
         self.metrics.service.record(done - t0)
         self.metrics.record_batch(len(reqs), self.runner.max_batch)
         for k, r in enumerate(reqs):
+            # deadline re-check at completion: a request that expired
+            # while its batch waited behind a slow/hedged predict must
+            # report DeadlineExceeded, not a stale success
+            if r.expired():
+                self.metrics.inc("expired")
+                self._resolve(
+                    r,
+                    exc=DeadlineExceeded(
+                        "deadline passed while the batch was in flight"
+                    ),
+                )
+                continue
             try:
                 dets = self.runner.detections_for(
                     out, batch, k, orig_hw=r.orig_hw
                 )
             except Exception as e:  # postprocess bug: fail this request
                 self.metrics.inc("failed")
-                r.future.set_exception(e)
+                self._resolve(r, exc=e)
                 continue
             self.metrics.inc("completed")
             self.metrics.e2e.record(time.monotonic() - r.enqueue_t)
-            r.future.set_result(dets)
+            self._resolve(r, dets)
 
     # ---------------------------------------------------------- reporting
     def snapshot(self) -> Dict:
         out = self.metrics.snapshot(self.runner.compile_cache)
         if self._pool is not None:
             out["completion"] = self._pool.stats()
+        if self._routed:
+            out["pool"] = self.runner.snapshot()
         return out

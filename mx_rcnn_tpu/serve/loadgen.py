@@ -45,11 +45,19 @@ def run_load(
     seed: int = 0,
     deadline_s: Optional[float] = None,
     queue_full_backoff: float = 0.002,
+    collect: bool = False,
 ) -> Dict:
     """Drive ``engine`` with ``num_requests`` synthetic images; returns a
     report dict (wall/throughput/outcome counts + the engine's metrics
     snapshot).  ``QueueFull`` is the backpressure signal — the client
-    backs off and resubmits, counting the rejection."""
+    backs off and resubmits, counting the rejection.
+
+    ``collect=True`` additionally stores each request's resolution under
+    ``report["_results"]`` — ``{index: ("ok", detections) | (kind, repr)}``
+    — which is what lets a faulted run be compared byte-for-byte against
+    an unfaulted one (pop the key before JSON-dumping the report).
+    Because traffic is derived from ``seed + index`` alone, equal indices
+    mean equal input images across runs."""
     size_rng = np.random.RandomState(seed)
     req_sizes = [
         sizes[size_rng.randint(len(sizes))] for i in range(num_requests)
@@ -57,6 +65,7 @@ def run_load(
     counter = iter(range(num_requests))
     lock = threading.Lock()
     outcomes = {"ok": 0, "deadline": 0, "error": 0, "queue_full_retries": 0}
+    results: Dict[int, Tuple[str, object]] = {}
 
     def note(key: str) -> None:
         with lock:
@@ -78,10 +87,17 @@ def run_load(
                     note("queue_full_retries")
                     time.sleep(queue_full_backoff)
             try:
-                fut.result()
+                dets = fut.result()
                 note("ok")
+                if collect:
+                    with lock:
+                        results[i] = ("ok", dets)
             except Exception as e:
-                note("deadline" if "Deadline" in type(e).__name__ else "error")
+                kind = "deadline" if "Deadline" in type(e).__name__ else "error"
+                note(kind)
+                if collect:
+                    with lock:
+                        results[i] = (kind, repr(e))
 
     threads = [
         threading.Thread(target=client, name=f"loadgen-{t}", daemon=True)
@@ -95,7 +111,7 @@ def run_load(
     wall = time.monotonic() - t0
 
     snap = engine.snapshot()
-    return {
+    report = {
         "requests": num_requests,
         "concurrency": concurrency,
         "sizes": [list(s) for s in sizes],
@@ -105,3 +121,6 @@ def run_load(
         "outcomes": outcomes,
         "engine": snap,
     }
+    if collect:
+        report["_results"] = results
+    return report

@@ -64,6 +64,26 @@ class LatencyHistogram:
         # bin upper edge, clamped so no percentile exceeds the true max
         return float(min(self._edges[idx], self.max_ms))
 
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Add ``other``'s counts into this histogram (the log-binned
+        design exists for exactly this: pool-level percentiles are the
+        bin-wise sum of per-replica histograms).  Requires identical bin
+        edges; returns self for chaining."""
+        if len(self._edges) != len(other._edges) or not np.array_equal(
+            self._edges, other._edges
+        ):
+            raise ValueError("cannot merge histograms with different bins")
+        with other._lock:
+            counts = other._counts.copy()
+            count, total, mx = other.count, other.total_ms, other.max_ms
+        with self._lock:
+            self._counts += counts
+            self.count += count
+            self.total_ms += total
+            if mx > self.max_ms:
+                self.max_ms = mx
+        return self
+
     @property
     def mean_ms(self) -> float:
         with self._lock:
@@ -98,6 +118,8 @@ class ServeMetrics:
         self.rejected = 0      # backpressure (queue full) + oversize
         self.expired = 0       # deadline passed before execution
         self.retried = 0       # batch re-executions via RetryPolicy
+        self.shed = 0          # rejected early on low healthy fraction
+        self.stopped = 0       # resolved EngineStopped at teardown
         # batch occupancy: real requests per padded device-batch slot
         self.batches = 0
         self.batch_real = 0
@@ -140,6 +162,8 @@ class ServeMetrics:
                     "rejected": self.rejected,
                     "expired": self.expired,
                     "retried": self.retried,
+                    "shed": self.shed,
+                    "stopped": self.stopped,
                 },
                 "batches": {
                     "count": self.batches,

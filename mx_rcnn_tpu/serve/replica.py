@@ -1,0 +1,520 @@
+"""One serving replica: a :class:`ServeRunner` wrapped in a health-gated
+state machine.
+
+The train side has been guarded since PR 1 (``GuardedLoop`` retries a
+diverged step, ``StepWatchdog`` aborts a wedged one), but a serving
+fleet cannot abort the process — a wedged or persistently-failing
+predict path on ONE device must cost that device's capacity, not the
+endpoint.  A :class:`Replica` therefore owns one runner, one dispatch
+queue, and one worker thread, and moves through:
+
+::
+
+    WARMING ──warmup ok──▶ HEALTHY ◀──probe ok / good dispatch──┐
+                              │                                 │
+                 failure / slow EWMA                        DEGRADED
+                              ▼                                 │
+                          DEGRADED ──failure budget / stall──▶ DRAINING
+                                                                │
+              (queued + in-flight dispatches fail over          │
+               with ReplicaDrained — the router requeues        ▼
+               them on a sibling; nothing is dropped)      RECOVERING
+                                                                │
+                      breaker backoff → fresh runner (factory → │
+                      recompile) → warmup → probe batch ────────┘
+                                 ok → HEALTHY (rejoin)
+                                 fail → breaker reopens, backoff ×2
+
+Health signals, all O(1) per dispatch:
+
+* **stall watchdog** — a wall-clock timer armed around every predict
+  (the :class:`~mx_rcnn_tpu.core.resilience.StepWatchdog` idiom: a
+  thread timer, because neither SIGALRM nor cooperative checks fire
+  while the worker is wedged inside native XLA code).  On expiry the
+  replica trips straight to DRAINING and its in-flight dispatch is
+  failed over immediately — the caller never waits out the wedge.
+* **consecutive-failure count** — a dispatch that fails all in-place
+  retries (``make_retry_policy("replica")``) marks DEGRADED; reaching
+  ``fail_threshold`` trips DRAINING.
+* **predict-latency EWMA** — a successful dispatch slower than
+  ``latency_factor ×`` the warmed EWMA marks DEGRADED (the router stops
+  routing to it; an idle DEGRADED replica self-probes its way back).
+
+Recovery runs on the replica's own worker thread: circuit-breaker
+backoff (exponential in the number of recent trips — a flapping replica
+waits longer each time), then a FRESH runner from the factory (a real
+recompile, not a state reset), ``warmup()`` over the ladder, and a probe
+batch through the same fault-injectable predict path; only a probe
+success rejoins the pool.  Every transition is appended to
+``transitions`` with a monotonic timestamp, reason, and batch ordinal —
+the log ``tests/test_replica.py`` asserts against the injected schedule.
+
+Fault injection: ``utils/faults.py :: predict_fault(replica, ordinal)``
+is called once per predict attempt, so every path above is
+deterministically reproducible on CPU (``predict_fail`` / ``predict_stall``
+/ ``replica_wedge`` keyed by replica index and batch ordinal).
+
+A note on hard wedges: the watchdog fails the *dispatch* over instantly,
+but the worker thread itself stays parked inside the native call until
+the runtime returns — recovery (and rejoin) begins at that point.  A
+truly permanent wedge keeps the replica in DRAINING forever, which is
+exactly the fleet-level behavior wanted: the pool routes around it and
+its capacity is simply absent until an operator restarts the process.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from mx_rcnn_tpu.core.resilience import RetryPolicy, make_retry_policy
+from mx_rcnn_tpu.serve.metrics import LatencyHistogram
+from mx_rcnn_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
+
+
+class ReplicaState(enum.Enum):
+    WARMING = "warming"
+    HEALTHY = "healthy"
+    DEGRADED = "degraded"
+    DRAINING = "draining"
+    RECOVERING = "recovering"
+
+
+class ReplicaDrained(RuntimeError):
+    """The dispatch's replica tripped into DRAINING before producing a
+    result — the router must requeue the batch on a sibling (this is a
+    routing signal, never a client-visible error)."""
+
+
+@dataclass(frozen=True)
+class HealthPolicy:
+    """Knobs for the per-replica health monitor and circuit breaker.
+
+    Defaults suit a real device; tests shrink every time constant.
+    ``retry`` is the in-place retry for one dispatch (transient device
+    hiccups) — deliberately tighter than the single-runner engine's
+    policy, because a pooled dispatch should fail over instead of
+    burning its latency budget in place.
+    """
+
+    stall_timeout: float = 30.0       # wall-clock watchdog per predict
+    fail_threshold: int = 2           # consecutive failed dispatches → DRAINING
+    latency_factor: float = 8.0       # dispatch slower than f×EWMA → DEGRADED
+    ewma_decay: float = 0.8           # EWMA update weight on the old value
+    ewma_warmup: int = 3              # dispatches before the EWMA gate arms
+    breaker_backoff: float = 0.05     # RECOVERING wait, doubled per recent trip
+    breaker_max_backoff: float = 2.0
+    flap_window: float = 30.0         # trips within this window count as flapping
+    retry: RetryPolicy = field(
+        default_factory=lambda: make_retry_policy("replica")
+    )
+
+
+@dataclass
+class _Dispatch:
+    """One batch handed to one replica; ``future`` resolves exactly once
+    with the predict outputs, a predict error, or :class:`ReplicaDrained`."""
+
+    batch: Dict[str, np.ndarray]
+    deadline: Optional[float] = None
+    kind: str = "serve"  # "serve" | "probe"
+    future: Future = field(default_factory=Future)
+    ordinal: int = -1    # per-replica batch ordinal, set at predict time
+
+    def resolve(self, result=None, exc: Optional[BaseException] = None) -> bool:
+        """Set the future if still unset; False when it already resolved
+        (the watchdog failed this dispatch over while we computed)."""
+        try:
+            if exc is not None:
+                self.future.set_exception(exc)
+            else:
+                self.future.set_result(result)
+            return True
+        except InvalidStateError:
+            return False
+
+
+class Replica:
+    """One pool member: runner + worker thread + health state machine."""
+
+    def __init__(
+        self,
+        index: int,
+        runner_factory: Callable[[int], Any],
+        policy: Optional[HealthPolicy] = None,
+        name: str = "replica",
+    ):
+        self.index = int(index)
+        self.policy = policy or HealthPolicy()
+        self._factory = runner_factory
+        self.runner = runner_factory(self.index)
+        self._lock = threading.Lock()
+        self._inbox: "queue.Queue[Optional[_Dispatch]]" = queue.Queue()
+        self._current: Optional[_Dispatch] = None
+        self._watchdog: Optional[threading.Timer] = None
+        self._stop = False
+        self.state = ReplicaState.WARMING
+        # health-monitor state
+        self._ordinal = 0
+        self._consecutive_failures = 0
+        self._ewma_s: Optional[float] = None
+        self._ewma_n = 0
+        self._trip_times: List[float] = []
+        # observability (read under no lock by snapshots: int/float writes
+        # are atomic enough for counters; the transition log is locked)
+        self.latency = LatencyHistogram()
+        self.transitions: List[Dict[str, Any]] = []
+        self.dispatches = 0
+        self.failures = 0
+        self.retried = 0
+        self.requeued_out = 0   # dispatches failed over with ReplicaDrained
+        self.abandoned = 0      # results that arrived after the failover
+        self.probes = 0
+        self.rewarms = 0
+        self.breaker_opens = 0
+        self.last_backoff = 0.0
+        self._t0 = time.monotonic()
+        self._worker = threading.Thread(
+            target=self._loop, name=f"{name}-{index}", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------- state
+    def _log_transition(self, new: ReplicaState, reason: str) -> None:
+        # caller holds self._lock
+        old = self.state
+        self.state = new
+        self.transitions.append(
+            {
+                "t": round(time.monotonic() - self._t0, 4),
+                "from": old.value,
+                "to": new.value,
+                "reason": reason,
+                "ordinal": self._ordinal,
+            }
+        )
+        logger.info(
+            "replica %d: %s -> %s (%s)", self.index, old.value, new.value,
+            reason,
+        )
+
+    def _set_state(self, new: ReplicaState, reason: str) -> None:
+        with self._lock:
+            if self.state is not new:
+                self._log_transition(new, reason)
+
+    @property
+    def routable(self) -> bool:
+        """The router dispatches ONLY to HEALTHY replicas."""
+        return self.state is ReplicaState.HEALTHY
+
+    def load(self) -> int:
+        """Queued + in-flight dispatches (the least-loaded routing key)."""
+        with self._lock:
+            return self._inbox.qsize() + (1 if self._current is not None else 0)
+
+    # ---------------------------------------------------------- dispatch
+    def submit(
+        self,
+        batch: Dict[str, np.ndarray],
+        deadline: Optional[float] = None,
+    ) -> _Dispatch:
+        """Enqueue one batch; returns the dispatch whose future resolves
+        exactly once.  A non-routable replica fails it immediately with
+        :class:`ReplicaDrained` instead of accepting work it would only
+        drain later."""
+        d = _Dispatch(batch=batch, deadline=deadline)
+        with self._lock:
+            if self._stop or self.state not in (
+                ReplicaState.HEALTHY, ReplicaState.DEGRADED
+            ):
+                d.resolve(exc=ReplicaDrained(
+                    f"replica {self.index} is {self.state.value}"
+                ))
+                return d
+            self._inbox.put(d)
+        return d
+
+    def trip(self, reason: str) -> None:
+        """Force DRAINING now (watchdog expiry, failure budget, or an
+        operator drain): fail the in-flight dispatch over, requeue-fail
+        everything queued, and let the worker run recovery.  Idempotent;
+        callable from any thread."""
+        with self._lock:
+            if self.state in (ReplicaState.DRAINING, ReplicaState.RECOVERING):
+                return
+            self._log_transition(ReplicaState.DRAINING, reason)
+            self._trip_times.append(time.monotonic())
+            cur = self._current
+        drained = ReplicaDrained(f"replica {self.index} draining ({reason})")
+        if cur is not None and cur.resolve(exc=drained):
+            self.requeued_out += 1
+        while True:
+            try:
+                d = self._inbox.get_nowait()
+            except queue.Empty:
+                break
+            if d is not None and d.resolve(exc=drained):
+                self.requeued_out += 1
+
+    def drain(self) -> None:
+        """Operator-initiated drain (same path as a health trip)."""
+        self.trip("drain")
+
+    # ------------------------------------------------------------ worker
+    def _loop(self) -> None:
+        self._recover(initial=True)
+        while not self._stop:
+            if self.state is ReplicaState.DRAINING:
+                self._recover()
+                continue
+            if self.state is ReplicaState.DEGRADED and self._inbox.empty():
+                self._probe()
+                continue
+            try:
+                d = self._inbox.get(timeout=0.02)
+            except queue.Empty:
+                continue
+            if d is None:
+                break
+            self._serve(d)
+
+    def _arm_watchdog(self, ordinal: int) -> None:
+        t = threading.Timer(
+            self.policy.stall_timeout,
+            lambda: self.trip(f"stall>{self.policy.stall_timeout:g}s"),
+        )
+        t.daemon = True
+        t.start()
+        self._watchdog = t
+
+    def _disarm_watchdog(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.cancel()
+            self._watchdog = None
+
+    def _predict(self, batch, ordinal: int, attempt: int):
+        if attempt:
+            self.retried += 1
+        faults.predict_fault(self.index, ordinal)
+        return self.runner.run(batch)
+
+    def _serve(self, d: _Dispatch) -> None:
+        with self._lock:
+            if self._stop or self.state not in (
+                ReplicaState.HEALTHY, ReplicaState.DEGRADED
+            ):
+                d.resolve(exc=ReplicaDrained(
+                    f"replica {self.index} is {self.state.value}"
+                ))
+                self.requeued_out += 1
+                return
+            self._current = d
+            d.ordinal = self._ordinal
+            self._ordinal += 1
+        self.dispatches += 1
+        self._arm_watchdog(d.ordinal)
+        t0 = time.monotonic()
+        try:
+            out = self.policy.retry.run(
+                lambda attempt: self._predict(d.batch, d.ordinal, attempt)
+            )
+        except Exception as e:  # noqa: BLE001 — typed failover, never a drop
+            self._disarm_watchdog()
+            with self._lock:
+                self._current = None
+            self.failures += 1
+            if not d.resolve(exc=e):
+                self.abandoned += 1
+            self._note_failure(d.ordinal)
+            return
+        self._disarm_watchdog()
+        dt = time.monotonic() - t0
+        with self._lock:
+            self._current = None
+        if not d.resolve(out):
+            # the watchdog already failed this dispatch over (the batch
+            # reran elsewhere); the late result is discarded, not served
+            self.abandoned += 1
+            return
+        self.latency.record(dt)
+        self._note_success(dt, d.ordinal)
+
+    # ----------------------------------------------------- health monitor
+    def _note_success(self, dt: float, ordinal: int) -> None:
+        self._consecutive_failures = 0
+        slow = False
+        if self._ewma_s is None:
+            self._ewma_s = dt
+        else:
+            if (
+                self._ewma_n >= self.policy.ewma_warmup
+                and dt > self.policy.latency_factor * self._ewma_s
+            ):
+                slow = True
+            self._ewma_s = (
+                self.policy.ewma_decay * self._ewma_s
+                + (1.0 - self.policy.ewma_decay) * dt
+            )
+        self._ewma_n += 1
+        if slow and self.state is ReplicaState.HEALTHY:
+            self._set_state(
+                ReplicaState.DEGRADED,
+                f"latency {dt * 1e3:.0f}ms > {self.policy.latency_factor:g}x ewma",
+            )
+        elif self.state is ReplicaState.DEGRADED and not slow:
+            self._set_state(ReplicaState.HEALTHY, "good dispatch")
+
+    def _note_failure(self, ordinal: int) -> None:
+        self._consecutive_failures += 1
+        if self._consecutive_failures >= self.policy.fail_threshold:
+            self.trip(f"{self._consecutive_failures} consecutive failures")
+        else:
+            self._set_state(ReplicaState.DEGRADED, "dispatch failed")
+
+    def _probe_batch(self) -> Dict[str, np.ndarray]:
+        """Smallest-rung all-zeros batch through the real prepare path —
+        the breaker's half-open probe and the DEGRADED self-check."""
+        bh, bw = next(iter(self.runner.ladder))
+        req = self.runner.make_request(np.zeros((bh, bw, 3), np.float32))
+        return self.runner.assemble([req])
+
+    def _probe(self) -> bool:
+        """One probe batch through the fault-injectable predict path;
+        success promotes DEGRADED→HEALTHY, failure counts toward the
+        drain budget exactly like a served dispatch."""
+        self.probes += 1
+        with self._lock:
+            ordinal = self._ordinal
+            self._ordinal += 1
+        t0 = time.monotonic()
+        try:
+            self.policy.retry.run(
+                lambda attempt: self._predict(self._probe_batch(), ordinal,
+                                              attempt)
+            )
+        except Exception:  # noqa: BLE001 — probes exist to absorb faults
+            self.failures += 1
+            self._note_failure(ordinal)
+            return False
+        self._note_success(time.monotonic() - t0, ordinal)
+        if self.state is ReplicaState.DEGRADED:
+            self._set_state(ReplicaState.HEALTHY, "probe ok")
+        return True
+
+    # ----------------------------------------------------------- recovery
+    def _backoff_s(self) -> float:
+        now = time.monotonic()
+        recent = [
+            t for t in self._trip_times if now - t <= self.policy.flap_window
+        ]
+        self._trip_times = recent
+        if len(recent) <= 1:
+            return 0.0  # first trip in the window: rejoin eagerly
+        return min(
+            self.policy.breaker_backoff * 2.0 ** (len(recent) - 2),
+            self.policy.breaker_max_backoff,
+        )
+
+    def _recover(self, initial: bool = False) -> None:
+        """WARMING/DRAINING → (breaker wait →) recompile → warmup →
+        probe → HEALTHY.  Runs on the worker thread; loops (with a
+        growing breaker backoff) until a probe passes or stop()."""
+        if not initial:
+            self._set_state(ReplicaState.RECOVERING, "begin recovery")
+        while not self._stop:
+            backoff = 0.0 if initial else self._backoff_s()
+            if backoff > 0.0:
+                self.breaker_opens += 1
+                self.last_backoff = backoff
+                logger.info(
+                    "replica %d: breaker open, backoff %.3fs "
+                    "(%d recent trips)", self.index, backoff,
+                    len(self._trip_times),
+                )
+                time.sleep(backoff)
+            try:
+                if not initial:
+                    # a REAL recompile: fresh runner (new jit callables,
+                    # new compile cache), then rewarm the whole ladder
+                    self.runner = self._factory(self.index)
+                    self.rewarms += 1
+                self.runner.warmup()
+            except Exception as e:  # noqa: BLE001 — keep the replica parked
+                self.failures += 1
+                logger.error("replica %d: rewarm failed: %r", self.index, e)
+                with self._lock:
+                    self._trip_times.append(time.monotonic())
+                initial = False
+                continue
+            # half-open: one probe batch must pass before taking traffic
+            self.probes += 1
+            with self._lock:
+                ordinal = self._ordinal
+                self._ordinal += 1
+            try:
+                self.policy.retry.run(
+                    lambda attempt: self._predict(
+                        self._probe_batch(), ordinal, attempt
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — breaker reopens
+                self.failures += 1
+                logger.warning(
+                    "replica %d: recovery probe failed: %r", self.index, e
+                )
+                with self._lock:
+                    self._trip_times.append(time.monotonic())
+                initial = False
+                continue
+            self._consecutive_failures = 0
+            self._set_state(
+                ReplicaState.HEALTHY, "warmup ok" if initial else "rejoin"
+            )
+            return
+
+    # ---------------------------------------------------------- lifecycle
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop the worker; queued dispatches fail over (never hang).  A
+        worker parked inside a wedged native call is abandoned as a
+        daemon thread — joining it would inherit the wedge."""
+        with self._lock:
+            self._stop = True
+        self.trip("stop")
+        self._inbox.put(None)
+        self._worker.join(timeout=timeout)
+
+    # -------------------------------------------------------- observability
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            transitions = list(self.transitions)
+            state = self.state.value
+        return {
+            "index": self.index,
+            "state": state,
+            "dispatches": self.dispatches,
+            "failures": self.failures,
+            "retried": self.retried,
+            "requeued_out": self.requeued_out,
+            "abandoned": self.abandoned,
+            "probes": self.probes,
+            "rewarms": self.rewarms,
+            "breaker_opens": self.breaker_opens,
+            "last_backoff_s": round(self.last_backoff, 4),
+            "ewma_ms": (
+                round(self._ewma_s * 1e3, 3) if self._ewma_s is not None
+                else None
+            ),
+            "latency": self.latency.snapshot(),
+            "transitions": transitions,
+        }

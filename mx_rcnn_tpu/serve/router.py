@@ -1,0 +1,374 @@
+"""Least-loaded, bucket-affine routing across a pool of replicas.
+
+:class:`ReplicaPool` presents the same surface as a single
+:class:`~mx_rcnn_tpu.serve.runner.ServeRunner` (``warmup`` / ``max_batch``
+/ ``make_request`` / ``assemble`` / ``run`` / ``detections_for`` /
+``compile_cache``), so the existing :class:`ServingEngine` front-end is
+the unchanged single intake: its assembler builds a bucket-homogeneous
+batch exactly as before and ``run()`` here decides WHICH replica
+predicts it.  Host-side pure methods (request prep, assembly, detection
+decode) delegate to replica 0's runner — they touch no device state, so
+they stay valid across that replica's rewarms.
+
+Routing policy, in order:
+
+* **exclude non-HEALTHY** — DEGRADED/DRAINING/RECOVERING replicas take
+  no new traffic (a DEGRADED replica self-probes its way back).
+* **least-loaded, bucket-affine** — primary key is queued+in-flight
+  load; ties break toward ``(index - hash(bucket)) % n``, so under even
+  load each bucket keeps hitting the same replica (warm jit signature,
+  no cross-replica compile churn) but the affinity yields instantly
+  under imbalance.
+* **hedge** — if the primary has not answered within a deadline-derived
+  hedge timeout, the SAME batch is dispatched to a second replica and
+  the two race; first success wins, the loser's result is discarded by
+  the dispatch future's resolve-once guard.
+* **requeue, never drop** — a dispatch failed with
+  :class:`~mx_rcnn_tpu.serve.replica.ReplicaDrained` (its replica
+  tripped mid-flight) is immediately re-dispatched to a sibling;
+  ``requeued`` counts these and the zero-lost-request test asserts the
+  batch still resolves.
+* **bounded failover** — a genuine predict error fails over to the next
+  candidate, at most ``n_replicas + 1`` attempts before the error
+  propagates (the engine fails the batch's requests with it).
+
+Load shedding lives at the intake, not here: the engine consults
+``healthy_fraction()`` on submit and rejects with ``QueueFull`` early
+when healthy capacity has collapsed — cheaper than queueing work the
+pool cannot clear before its deadlines.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, wait
+from concurrent.futures import TimeoutError as FutureTimeout
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.serve.metrics import LatencyHistogram
+from mx_rcnn_tpu.serve.replica import (
+    HealthPolicy,
+    Replica,
+    ReplicaDrained,
+    ReplicaState,
+)
+
+
+class NoHealthyReplica(RuntimeError):
+    """Every replica is draining/recovering — the pool has zero capacity
+    (the engine surfaces this as a failed batch; intake shedding should
+    make it rare)."""
+
+
+class _MergedCompileCache:
+    """Read-only pool-wide view over per-replica compile caches.  Keeps
+    the single-replica invariant legible at pool level: after warmup,
+    ``misses == n_replicas × len(ladder)`` and never grows."""
+
+    def __init__(self, pool: "ReplicaPool"):
+        self._pool = pool
+
+    def _caches(self):
+        return [r.runner.compile_cache for r in self._pool.replicas]
+
+    @property
+    def hits(self) -> int:
+        return sum(c.hits for c in self._caches())
+
+    @property
+    def misses(self) -> int:
+        return sum(c.misses for c in self._caches())
+
+    def snapshot(self) -> Dict:
+        per = [c.snapshot() for c in self._caches()]
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "per_replica": per,
+        }
+
+
+class ReplicaPool:
+    """N health-gated replicas behind one runner-shaped facade."""
+
+    def __init__(
+        self,
+        runner_factory: Callable[[int], Any],
+        n_replicas: int,
+        policy: Optional[HealthPolicy] = None,
+        hedge_timeout: float = 2.0,
+        min_hedge_timeout: float = 0.05,
+        no_healthy_wait: float = 0.5,
+    ):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.policy = policy or HealthPolicy()
+        self.hedge_timeout = float(hedge_timeout)
+        self.min_hedge_timeout = float(min_hedge_timeout)
+        self.no_healthy_wait = float(no_healthy_wait)
+        self.replicas: List[Replica] = [
+            Replica(i, runner_factory, policy=self.policy)
+            for i in range(n_replicas)
+        ]
+        self._lock = threading.Lock()
+        # pool-level routing counters
+        self.dispatched = 0
+        self.completed = 0
+        self.requeued = 0
+        self.hedged = 0
+        self.hedge_wins = 0
+        self.failovers = 0
+        self.no_healthy = 0
+        self.service = LatencyHistogram()  # per-batch, routing included
+
+    # ------------------------------------------------- runner facade
+    # Host-side pure methods delegate to replica 0's CURRENT runner;
+    # they read only config/ladder state shared by every replica.
+    @property
+    def _ref(self):
+        return self.replicas[0].runner
+
+    @property
+    def max_batch(self) -> int:
+        return self._ref.max_batch
+
+    @property
+    def ladder(self):
+        return self._ref.ladder
+
+    @property
+    def cfg(self):
+        return self._ref.cfg
+
+    @property
+    def compile_cache(self) -> _MergedCompileCache:
+        return _MergedCompileCache(self)
+
+    def make_request(self, im, deadline: Optional[float] = None):
+        return self._ref.make_request(im, deadline)
+
+    def assemble(self, requests):
+        return self._ref.assemble(requests)
+
+    def detections_for(self, out, batch, index, orig_hw=None, thresh=None):
+        return self._ref.detections_for(
+            out, batch, index, orig_hw=orig_hw, thresh=thresh
+        )
+
+    def warmup(self, timeout: float = 300.0) -> int:
+        """Block until every replica has warmed its ladder and passed its
+        initial probe; returns total compile misses across the pool."""
+        t0 = time.monotonic()
+        for r in self.replicas:
+            while r.state is ReplicaState.WARMING:
+                if time.monotonic() - t0 > timeout:
+                    raise TimeoutError(
+                        f"replica {r.index} still warming after {timeout:g}s"
+                    )
+                time.sleep(0.01)
+        return self.compile_cache.misses
+
+    # ------------------------------------------------------- routing
+    def healthy_fraction(self) -> float:
+        n = sum(1 for r in self.replicas if r.routable)
+        return n / len(self.replicas)
+
+    def _pick(
+        self, bucket: Tuple[int, int], exclude: Tuple[int, ...] = ()
+    ) -> Optional[Replica]:
+        affinity = hash(bucket)
+        n = len(self.replicas)
+        best = None
+        best_key = None
+        for r in self.replicas:
+            if r.index in exclude or not r.routable:
+                continue
+            key = (r.load(), (r.index - affinity) % n)
+            if best_key is None or key < best_key:
+                best, best_key = r, key
+        return best
+
+    def _hedge_s(self, deadline: Optional[float]) -> float:
+        """Half the remaining deadline budget, clamped into
+        [min_hedge_timeout, hedge_timeout] — a tight deadline hedges
+        sooner, no deadline uses the configured default."""
+        if deadline is None:
+            return self.hedge_timeout
+        remaining = deadline - time.monotonic()
+        return min(
+            self.hedge_timeout,
+            max(self.min_hedge_timeout, remaining * 0.5),
+        )
+
+    def run(
+        self,
+        batch: Dict[str, np.ndarray],
+        deadline: Optional[float] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Predict ``batch`` on some healthy replica: least-loaded pick,
+        hedge past the timeout, requeue on drain, fail over on error.
+        Raises :class:`NoHealthyReplica` when the pool has no capacity,
+        or the last replica error after bounded failover."""
+        bucket = tuple(batch["images"].shape[1:3])
+        t0 = time.monotonic()
+        attempts = 0
+        max_attempts = len(self.replicas) + 1
+        last_exc: Optional[BaseException] = None
+        exclude: Tuple[int, ...] = ()
+        while attempts < max_attempts:
+            attempts += 1
+            primary = self._pick(bucket, exclude)
+            if primary is None and exclude:
+                # every sibling already failed this batch — retry the
+                # excluded set before giving up (a replica may have
+                # recovered, and a transient error deserves a second lap)
+                exclude = ()
+                primary = self._pick(bucket)
+            if primary is None:
+                primary = self._wait_for_healthy(bucket)
+            if primary is None:
+                with self._lock:
+                    self.no_healthy += 1
+                raise NoHealthyReplica(
+                    "no healthy replica (all draining/recovering)"
+                ) from last_exc
+            with self._lock:
+                self.dispatched += 1
+            d = primary.submit(batch, deadline)
+            try:
+                out = d.future.result(timeout=self._hedge_s(deadline))
+                self._done(t0)
+                return out
+            except ReplicaDrained as e:
+                with self._lock:
+                    self.requeued += 1
+                last_exc = e
+                continue  # replica tripped mid-flight: requeue elsewhere
+            except FutureTimeout:
+                out = self._race_hedge(batch, bucket, deadline, primary, d)
+                if out is not None:
+                    self._done(t0)
+                    return out
+                last_exc = RuntimeError(
+                    f"hedged batch failed on replica {primary.index} "
+                    f"and its hedge"
+                )
+                exclude = exclude + (primary.index,)
+            except Exception as e:  # noqa: BLE001 — bounded failover
+                with self._lock:
+                    self.failovers += 1
+                last_exc = e
+                exclude = exclude + (primary.index,)
+        raise last_exc if last_exc is not None else NoHealthyReplica(
+            "routing attempts exhausted"
+        )
+
+    def _wait_for_healthy(self, bucket) -> Optional[Replica]:
+        """Brief bounded poll for a recovering pool before declaring
+        zero capacity (a drained replica often rejoins within ms on the
+        breaker's first lap)."""
+        t_end = time.monotonic() + self.no_healthy_wait
+        while time.monotonic() < t_end:
+            time.sleep(0.01)
+            r = self._pick(bucket)
+            if r is not None:
+                return r
+        return None
+
+    def _race_hedge(self, batch, bucket, deadline, primary, d):
+        """Primary exceeded the hedge timeout: dispatch the same batch to
+        a second replica and race.  Returns the first success, or None
+        when both legs fail.  The losing leg's result is discarded by its
+        replica (resolve-once dispatch future → ``abandoned``)."""
+        with self._lock:
+            self.hedged += 1
+        backup = self._pick(bucket, exclude=(primary.index,))
+        if backup is None:
+            # nowhere to hedge: keep waiting on the primary alone
+            try:
+                return d.future.result()
+            except Exception:  # noqa: BLE001
+                return None
+        d2 = backup.submit(batch, deadline)
+        futures = {d.future: "primary", d2.future: "hedge"}
+        while futures:
+            done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
+            for f in done:
+                leg = futures.pop(f)
+                try:
+                    out = f.result()
+                except Exception:  # noqa: BLE001 — wait for the other leg
+                    continue
+                if leg == "hedge":
+                    with self._lock:
+                        self.hedge_wins += 1
+                return out
+        return None
+
+    def _done(self, t0: float) -> None:
+        with self._lock:
+            self.completed += 1
+        self.service.record(time.monotonic() - t0)
+
+    # --------------------------------------------------- lifecycle
+    def close(self) -> None:
+        for r in self.replicas:
+            r.stop()
+
+    # ------------------------------------------------ observability
+    def snapshot(self) -> Dict:
+        per = [r.snapshot() for r in self.replicas]
+        merged = LatencyHistogram()
+        for r in self.replicas:
+            merged.merge(r.latency)
+        with self._lock:
+            counters = {
+                "dispatched": self.dispatched,
+                "completed": self.completed,
+                "requeued": self.requeued,
+                "hedged": self.hedged,
+                "hedge_wins": self.hedge_wins,
+                "failovers": self.failovers,
+                "no_healthy": self.no_healthy,
+            }
+        return {
+            "replicas": per,
+            "states": {r.index: r.state.value for r in self.replicas},
+            "healthy_fraction": round(self.healthy_fraction(), 4),
+            "routing": counters,
+            "latency": {
+                "pool_service": self.service.snapshot(),
+                "replica_predict_merged": merged.snapshot(),
+            },
+            "compile": self.compile_cache.snapshot(),
+        }
+
+
+def make_replica_factory(
+    build_runner: Callable[..., Any],
+    params,
+    devices: Optional[List] = None,
+    **runner_kwargs,
+) -> Callable[[int], Any]:
+    """Runner factory that pins each replica's params to its own device.
+
+    ``jax.device_put(params, device)`` yields COMMITTED arrays, so every
+    jit the replica's Predictor traces executes on that device — replica
+    i's compute never contends with replica j's.  ``devices`` defaults to
+    :func:`mx_rcnn_tpu.parallel.mesh.replica_slices` round-robin over the
+    local device set (8 virtual CPU devices in tests).
+    """
+    import jax
+
+    from mx_rcnn_tpu.parallel import mesh
+
+    def factory(index: int):
+        devs = devices if devices is not None else mesh.replica_slices()
+        device = devs[index % len(devs)]
+        pinned = jax.device_put(params, device)
+        return build_runner(params=pinned, **runner_kwargs)
+
+    return factory

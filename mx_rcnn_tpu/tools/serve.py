@@ -73,6 +73,11 @@ def main():
                    "init when omitted — latency numbers are still valid)")
     p.add_argument("--small", action="store_true",
                    help="tiny config + small images for a CPU smoke run")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve through a ReplicaPool of this many health-"
+                   "gated replicas (1 still exercises the pool path)")
+    p.add_argument("--force_pool", action="store_true",
+                   help="route through ReplicaPool even at --replicas 1")
     p.add_argument("--max_batch", type=int, default=4)
     p.add_argument("--linger_ms", type=float, default=5.0)
     p.add_argument("--max_queue", type=int, default=64)
@@ -105,14 +110,28 @@ def main():
         )["params"]
         logger.warning("no --params — serving a random-init model")
 
-    runner = ServeRunner(model, params, cfg, max_batch=args.max_batch)
+    if args.replicas > 1 or args.force_pool:
+        from mx_rcnn_tpu.serve.router import ReplicaPool, make_replica_factory
+
+        factory = make_replica_factory(
+            lambda params: ServeRunner(
+                model, params, cfg, max_batch=args.max_batch
+            ),
+            params,
+        )
+        runner = ReplicaPool(factory, n_replicas=args.replicas)
+    else:
+        runner = ServeRunner(model, params, cfg, max_batch=args.max_batch)
     engine = ServingEngine(
         runner,
         max_linger=args.linger_ms / 1000.0,
         max_queue=args.max_queue,
         in_flight=args.in_flight,
     )
-    logger.info("warming up %d bucket(s)...", len(runner.ladder))
+    logger.info(
+        "warming up %d bucket(s) x %d replica(s)...",
+        len(runner.ladder), args.replicas,
+    )
     with engine:
         report = run_load(
             engine,
@@ -125,6 +144,8 @@ def main():
                 if args.deadline_ms is not None else None
             ),
         )
+    if hasattr(runner, "close"):
+        runner.close()
     print(json.dumps(report, indent=1))
     if args.out:
         with open(args.out, "w") as f:

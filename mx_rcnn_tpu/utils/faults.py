@@ -24,9 +24,29 @@ Spec grammar — comma-separated entries ``KIND@KEY[xTIMES][:ARG]``::
     stall@STEP:SECONDS     sleep SECONDS at guarded step STEP (drives the
                            step past the watchdog deadline)
 
+Serve-phase injectors (ISSUE 6) are keyed ``REPLICA.ORDINAL`` — the
+replica index and its per-replica batch ordinal (every dispatch the
+replica predicts, probe batches included, counts one ordinal; retry
+attempts within a dispatch share the ordinal, so ``xN`` spans attempts).
+``ORDINAL`` may be ``*`` to match every batch on that replica::
+
+    predict_fail@R.B[xN]     raise InjectedPredictFault on replica R's
+                             batch B (x1 = transient, absorbed by the
+                             replica's RetryPolicy; unbounded = the
+                             dispatch fails and the router fails over)
+    predict_stall@R.B:SECS   sleep SECS inside replica R's predict of
+                             batch B (default 0.25 — past the hedge
+                             timeout but under the stall watchdog:
+                             the hedge-win path)
+    replica_wedge@R.B:SECS   sleep SECS (default 5.0 — past the stall
+                             watchdog: the replica trips DRAINING, its
+                             in-flight batch is requeued, and it
+                             rewarms/rejoins once the wedge releases)
+
 Example::
 
     MX_RCNN_FAULTS="nan_loss@5,record_fail@3,save_crash@2,stall@7:30"
+    MX_RCNN_FAULTS="predict_fail@0.2x1,replica_wedge@1.0:3,predict_stall@2.*x4:0.4"
 
 Injection sites are no-ops (one env lookup) when the variable is unset,
 so production paths pay nothing.
@@ -52,10 +72,20 @@ class SimulatedCrash(RuntimeError):
     writer cannot clean up, the ``.tmp`` dir is left uncommitted)."""
 
 
+class InjectedPredictFault(RuntimeError):
+    """Raised by the serve-phase injector inside a replica's predict — a
+    RuntimeError, so real retry/failover handling treats it exactly like
+    a device/relay fault."""
+
+
+# serve-phase kinds take the compound REPLICA.ORDINAL key
+_SERVE_KINDS = ("predict_fail", "predict_stall", "replica_wedge")
+
+
 @dataclass
 class _Fault:
     kind: str
-    key: int
+    key: object  # int (step/record/call) or (replica, ordinal|None) tuple
     times: Optional[int]  # None = unbounded
     arg: float
     fired: int = 0
@@ -77,6 +107,14 @@ class _Registry:
 _registry: Optional[_Registry] = None
 
 
+def _parse_key(s: str):
+    """``R.B`` / ``R.*`` → (replica, ordinal|None); plain int otherwise."""
+    if "." in s:
+        r, _, o = s.partition(".")
+        return (int(r), None if o == "*" else int(o))
+    return int(s)
+
+
 def _parse(spec: str) -> List[_Fault]:
     out = []
     for entry in spec.split(","):
@@ -91,11 +129,12 @@ def _parse(spec: str) -> List[_Fault]:
         if "x" in rest:
             rest, _, times_s = rest.partition("x")
             times = int(times_s)
-        defaults = {"spike": 1e4, "stall": 5.0}
+        defaults = {"spike": 1e4, "stall": 5.0,
+                    "predict_stall": 0.25, "replica_wedge": 5.0}
         out.append(
             _Fault(
                 kind=kind,
-                key=int(rest),
+                key=_parse_key(rest),
                 times=times,
                 arg=float(arg_s) if arg_s is not None else defaults.get(kind, 0.0),
             )
@@ -171,3 +210,29 @@ def stall(step: int) -> None:
     for f in reg.faults:
         if f.kind == "stall" and f.key == step and f.fire():
             time.sleep(f.arg)
+
+
+def predict_fault(replica: int, ordinal: int) -> None:
+    """Replica predict hook (``serve/replica.py``): raise or stall this
+    attempt.  Called once per predict ATTEMPT with the dispatch's
+    (replica, ordinal) coordinates; the first matching un-exhausted
+    fault fires (raise for ``predict_fail``, sleep for ``predict_stall``
+    / ``replica_wedge`` — the two stalls differ only in their default
+    duration relative to the hedge timeout vs the stall watchdog)."""
+    reg = _active()
+    if reg is None:
+        return
+    for f in reg.faults:
+        if f.kind not in _SERVE_KINDS or not isinstance(f.key, tuple):
+            continue
+        r, o = f.key
+        if r != replica or (o is not None and o != ordinal):
+            continue
+        if not f.fire():
+            continue
+        if f.kind == "predict_fail":
+            raise InjectedPredictFault(
+                f"injected predict failure: replica {replica} batch {ordinal}"
+            )
+        time.sleep(f.arg)
+        return
