@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast resilience bench bench-eval eval-bench serve serve-fault pipeline integration-gate clean-native
+.PHONY: native test test-kernels test-fast resilience bench bench-eval eval-bench serve serve-fault swap pipeline integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -80,6 +80,17 @@ serve-fault:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve_fault --serve_requests 24 \
 	      --serve_concurrency 6 --serve_max_batch 2 \
 	      --out BENCH_serve_fault_cpu.json
+
+# model-lifecycle serving bench (ISSUE 7): live hot-swap under load on a
+# 2-replica pool (zero lost requests, byte-identical detections outside
+# the swap window, zero recompiles through the swap), the
+# verify/warm/canary fault-rollback matrix, and two model families
+# through one batcher with zero steady-state recompiles; emits JSON
+# lines + the BENCH_swap_cpu.json artifact
+swap:
+	JAX_PLATFORMS=cpu $(PY) bench.py --swap --serve_requests 24 \
+	      --serve_concurrency 6 --serve_max_batch 2 --serve_replicas 2 \
+	      --out BENCH_swap_cpu.json
 
 # device-resident step pipeline bench (ISSUE 4): feed occupancy, fetch
 # stalls, K=1 byte-identical check on the CPU smoke config; emits JSON

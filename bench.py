@@ -435,6 +435,358 @@ def _dets_equal(a, b) -> bool:
     )
 
 
+def bench_swap(
+    network: str,
+    requests: int,
+    concurrency: int,
+    max_batch: int,
+    linger_ms: float,
+    small: bool = True,
+    replicas: int = 2,
+) -> tuple:
+    """Model-lifecycle bench (ISSUE 7): live hot-swap under load, the
+    fault-rollback matrix, and two-family tenancy through one batcher.
+
+    Three scenarios, each with ``deterministic=True`` runners so results
+    are bitwise comparable across waves and engines:
+
+    * ``hot_swap`` — one engine serves three load waves: wave A pins the
+      v1 reference detections, wave B runs with a background
+      ``engine.swap`` firing mid-load (blocking through commit + canary),
+      wave C pins v2.  Wave B requests are classified against the swap
+      window via per-request timestamps: done-before must match v1
+      byte-for-byte, submitted-after must match v2, straddlers must
+      match one of the two.  Zero lost/failed requests and ZERO compile
+      misses from warmup through the swap (the candidate warms through
+      the already-compiled executables — params are a jit argument).
+    * ``rollback`` — one registry takes three swap attempts faulted (by
+      registry-wide swap ordinal) at verify, warm, and canary; after
+      every rollback a load wave must still serve v1 bytes, and the 4th
+      (unfaulted) swap must land v2.
+    * ``tenancy`` — a second model family rides the same batcher/ladder;
+      two identical mixed-model waves prove per-(model, bucket) compile
+      hits after warmup: zero steady-state recompiles.
+    """
+    import os
+    import tempfile
+    import threading
+
+    import jax
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.core.checkpoint import save_checkpoint
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.loadgen import DEFAULT_SIZES, run_load
+    from mx_rcnn_tpu.serve.registry import (
+        DEFAULT_MODEL,
+        ModelRegistry,
+        SwapRolledBack,
+    )
+    from mx_rcnn_tpu.serve.router import ReplicaPool, make_replica_factory
+    from mx_rcnn_tpu.serve.runner import ServeRunner
+    from mx_rcnn_tpu.tools.serve import small_config
+    from mx_rcnn_tpu.utils import faults
+
+    if small:
+        cfg = small_config(network)
+        sizes = ((72, 96), (96, 128), (64, 80))
+    else:
+        cfg = generate_config(network, "PascalVOC")
+        sizes = DEFAULT_SIZES
+    model = build_model(cfg)
+    h, w = cfg.SHAPE_BUCKETS[0]
+
+    def init_params(seed):
+        return model.init(
+            {"params": jax.random.key(seed)},
+            np.zeros((1, h, w, 3), np.float32),
+            np.array([[h, w, 1.0]], np.float32),
+            train=False,
+        )["params"]
+
+    params_v1 = init_params(0)
+    # same structure/shapes, different values: the signature gate admits
+    # it and the swap visibly changes detections
+    ckpt_v2 = save_checkpoint(
+        os.path.join(tempfile.mkdtemp(prefix="bench-swap-"), "v2"),
+        {"params": init_params(1)}, 1,
+    )
+
+    def make_engine(n_replicas):
+        reg = ModelRegistry()
+        reg.register(DEFAULT_MODEL, model, cfg, params_v1)
+        if n_replicas > 1:
+            factory = make_replica_factory(
+                lambda registry, device: ServeRunner(
+                    registry=registry, device=device, max_batch=max_batch,
+                    deterministic=True,
+                ),
+                registry=reg,
+            )
+            runner = ReplicaPool(factory, n_replicas=n_replicas)
+        else:
+            runner = ServeRunner(
+                registry=reg, max_batch=max_batch, deterministic=True
+            )
+        eng = ServingEngine(
+            runner, max_linger=linger_ms / 1000.0,
+            in_flight=max(2, n_replicas),
+        )
+        return eng, runner
+
+    def load(eng, n=requests, models=None):
+        return run_load(
+            eng, num_requests=n, concurrency=concurrency, sizes=sizes,
+            seed=0, collect=True, models=models,
+        )
+
+    def ok_dets(report):
+        return {
+            i: r for i, (kind, r) in report["_results"].items() if kind == "ok"
+        }
+
+    def wave_summary(report):
+        out = report["outcomes"]
+        resolved = out["ok"] + out["deadline"] + out["error"]
+        return {
+            "outcomes": out,
+            "lost_requests": report["requests"] - resolved,
+            "imgs_per_sec": report["imgs_per_sec"],
+            "wall_s": report["wall_s"],
+        }
+
+    # ------------------------------------------- scenario 1: hot_swap
+    # the swap wave runs 2x requests: the blocking swap (dominated by
+    # the host-side checkpoint restore on CPU) must RETURN while load is
+    # still flowing, or no request lands entirely after the window
+    n_swap = 2 * requests
+    eng, runner = make_engine(max(1, replicas))
+    swap_out = {}
+    with eng:
+        rep_a = load(eng, n=n_swap)
+        ref_v1 = ok_dets(rep_a)
+        misses_warm = eng.snapshot()["compile"]["misses"]
+        base_done = eng.metrics.completed
+
+        def fire_swap():
+            # wait until wave B is genuinely mid-flight, then block
+            # through the full verify → warm → commit → canary pipeline
+            t_end = time.time() + 120.0
+            while (eng.metrics.completed - base_done < max(1, requests // 3)
+                   and time.time() < t_end):
+                time.sleep(0.002)
+            swap_out["t0"] = time.monotonic()
+            try:
+                swap_out["result"] = eng.swap(
+                    DEFAULT_MODEL, ckpt_v2, block=True, timeout=300
+                )
+            except Exception as e:  # noqa: BLE001 — recorded as evidence
+                swap_out["error"] = repr(e)
+            swap_out["t1"] = time.monotonic()
+
+        th = threading.Thread(target=fire_swap, name="bench-swap")
+        th.start()
+        rep_b = load(eng, n=n_swap)
+        th.join()
+        rep_c = load(eng, n=n_swap)
+        ref_v2 = ok_dets(rep_c)
+        snap = eng.snapshot()
+    if hasattr(runner, "close"):
+        runner.close()
+
+    misses_end = snap["compile"]["misses"]
+    dets_b, times_b = ok_dets(rep_b), rep_b["_times"]
+    t0, t1 = swap_out.get("t0"), swap_out.get("t1")
+    pre = post = straddle = 0
+    pre_ok = post_ok = straddle_ok = True
+    for i, (ts, td) in times_b.items():
+        if i not in dets_b or t0 is None:
+            continue
+        if td <= t0:
+            pre += 1
+            pre_ok &= _dets_equal(dets_b[i], ref_v1[i])
+        elif ts >= t1:
+            post += 1
+            post_ok &= _dets_equal(dets_b[i], ref_v2[i])
+        else:
+            straddle += 1
+            straddle_ok &= (
+                _dets_equal(dets_b[i], ref_v1[i])
+                or _dets_equal(dets_b[i], ref_v2[i])
+            )
+    versions_changed_output = sum(
+        1 for i in ref_v1 if i in ref_v2 and not _dets_equal(ref_v1[i], ref_v2[i])
+    )
+    waves = [wave_summary(r) for r in (rep_a, rep_b, rep_c)]
+    hot_swap = {
+        "replicas": max(1, replicas),
+        "wave_requests": n_swap,
+        "waves": waves,
+        "lost_requests": sum(wv["lost_requests"] for wv in waves),
+        "failed_requests": sum(
+            wv["outcomes"]["error"] + wv["outcomes"]["deadline"]
+            for wv in waves
+        ),
+        "swap": swap_out.get("result", swap_out.get("error")),
+        "swap_block_wall_s": (
+            round(t1 - t0, 3) if t0 is not None else None
+        ),
+        "window": {
+            "pre": pre, "post": post, "straddle": straddle,
+            "pre_byte_identical_v1": bool(pre_ok),
+            "post_byte_identical_v2": bool(post_ok),
+            "straddle_one_of_two": bool(straddle_ok),
+        },
+        "versions_changed_output": versions_changed_output,
+        "compile_misses_after_warmup": misses_warm,
+        "compile_misses_final": misses_end,
+        "recompiles_through_swap": misses_end - misses_warm,
+        "registry": snap.get("registry"),
+    }
+
+    # ------------------------------------------- scenario 2: rollback
+    prior = os.environ.get(faults.ENV_VAR)
+    rollback = {}
+    n_check = max(8, requests // 4)
+    try:
+        # keyed by registry-wide swap ordinal: attempt 1 dies at verify,
+        # 2 at warm, 3 at canary; attempt 4 finds no matching fault
+        os.environ[faults.ENV_VAR] = (
+            "swap_verify_fail@1,swap_warm_fail@2,canary_fail@3"
+        )
+        faults.reset()
+        eng2, runner2 = make_engine(1)
+        with eng2:
+            for stage in ("verify", "warm", "canary"):
+                entry = {"rolled_back": False}
+                try:
+                    eng2.swap(DEFAULT_MODEL, ckpt_v2, block=True, timeout=300)
+                except SwapRolledBack as e:
+                    entry["rolled_back"] = True
+                    entry["stage"] = e.stage
+                rep = load(eng2, n=n_check)
+                dets = ok_dets(rep)
+                entry["still_serving_v1_bytes"] = bool(dets) and all(
+                    _dets_equal(dets[i], ref_v1[i]) for i in dets
+                )
+                entry.update(wave_summary(rep))
+                rollback[stage] = entry
+            final = eng2.swap(DEFAULT_MODEL, ckpt_v2, block=True, timeout=300)
+            rep = load(eng2, n=n_check)
+            dets = ok_dets(rep)
+            rollback["final_swap"] = {
+                "result": final,
+                "serving_v2_bytes": bool(dets) and all(
+                    _dets_equal(dets[i], ref_v2[i]) for i in dets
+                ),
+                **wave_summary(rep),
+            }
+            rollback["registry"] = eng2.snapshot().get("registry")
+        if hasattr(runner2, "close"):
+            runner2.close()
+    finally:
+        if prior is None:
+            os.environ.pop(faults.ENV_VAR, None)
+        else:
+            os.environ[faults.ENV_VAR] = prior
+        faults.reset()
+
+    # ------------------------------------------- scenario 3: tenancy
+    tenant_net = "vgg" if network != "vgg" else "resnet50"
+    t_cfg = small_config(tenant_net) if small else generate_config(
+        tenant_net, "PascalVOC"
+    )
+    t_model = build_model(t_cfg)
+    th_, tw_ = t_cfg.SHAPE_BUCKETS[0]
+    t_params = t_model.init(
+        {"params": jax.random.key(0)},
+        np.zeros((1, th_, tw_, 3), np.float32),
+        np.array([[th_, tw_, 1.0]], np.float32),
+        train=False,
+    )["params"]
+    reg3 = ModelRegistry()
+    reg3.register(DEFAULT_MODEL, model, cfg, params_v1)
+    reg3.register("tenant", t_model, t_cfg, t_params)
+    runner3 = ServeRunner(
+        registry=reg3, max_batch=max_batch, deterministic=True
+    )
+    eng3 = ServingEngine(
+        runner3, max_linger=linger_ms / 1000.0, in_flight=2
+    )
+    mix = [None, "tenant"]
+    with eng3:
+        rep1 = load(eng3, models=mix)
+        m1 = eng3.snapshot()["compile"]["misses"]
+        rep2 = load(eng3, models=mix)
+        snap3 = eng3.snapshot()
+    tenancy = {
+        "families": {DEFAULT_MODEL: network, "tenant": tenant_net},
+        "waves": [wave_summary(rep1), wave_summary(rep2)],
+        "per_model": snap3.get("models"),
+        "compile_misses_after_first_wave": m1,
+        "compile_misses_final": snap3["compile"]["misses"],
+        "steady_state_recompiles": snap3["compile"]["misses"] - m1,
+        "compile_hits": snap3["compile"]["hits"],
+    }
+
+    tag = _METRIC_NAMES[network].replace("_e2e", "")
+    rollback_ok = all(
+        rollback[s]["rolled_back"] and rollback[s]["still_serving_v1_bytes"]
+        for s in ("verify", "warm", "canary")
+    ) and rollback["final_swap"]["serving_v2_bytes"]
+    records = [
+        {
+            "metric": f"swap_lost_requests_{tag}",
+            "value": hot_swap["lost_requests"], "unit": "requests",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"swap_failed_requests_{tag}",
+            "value": hot_swap["failed_requests"], "unit": "requests",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"swap_pre_window_byte_identical_{tag}",
+            "value": int(pre_ok and pre > 0), "unit": "bool",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"swap_post_window_byte_identical_{tag}",
+            "value": int(post_ok and post > 0), "unit": "bool",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"swap_recompiles_through_swap_{tag}",
+            "value": hot_swap["recompiles_through_swap"], "unit": "compiles",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"swap_block_wall_s_{tag}",
+            "value": hot_swap["swap_block_wall_s"], "unit": "seconds",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"swap_rollback_matrix_ok_{tag}",
+            "value": int(rollback_ok), "unit": "bool", "vs_baseline": None,
+        },
+        {
+            "metric": f"swap_tenancy_steady_state_recompiles_{tag}",
+            "value": tenancy["steady_state_recompiles"], "unit": "compiles",
+            "vs_baseline": None,
+        },
+    ]
+    report = {
+        "requests": requests,
+        "concurrency": concurrency,
+        "max_batch": max_batch,
+        "hot_swap": hot_swap,
+        "rollback": rollback,
+        "tenancy": tenancy,
+    }
+    return records, report
+
+
 def _smoke_config(batch_images: int):
     """Tiny CPU-runnable train config (96×96 bucket, shrunk RPN/ROI
     budgets) — the same shrink the CLI smoke tests use, so the pipeline
@@ -679,6 +1031,13 @@ def main():
              "byte-identical + recovery-time evidence)",
     )
     ap.add_argument(
+        "--swap", action="store_true",
+        help="model-lifecycle serving bench: live hot-swap under load "
+             "(zero lost, byte-identical outside the swap window, zero "
+             "recompiles), verify/warm/canary rollback matrix, and "
+             "two-family tenancy through one batcher",
+    )
+    ap.add_argument(
         "--serve_full", action="store_true",
         help="serve at the full config (default: tiny CPU-runnable one)",
     )
@@ -741,6 +1100,20 @@ def main():
         records, report = bench_pipeline(
             args.pipeline_steps, args.aux_interval, args.feed_depth,
             args.pipeline_batch,
+        )
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
+
+    if args.swap:
+        network = "resnet50" if args.network == "resnet" else args.network
+        records, report = bench_swap(
+            network, args.serve_requests, args.serve_concurrency,
+            args.serve_max_batch, args.serve_linger_ms,
+            small=not args.serve_full, replicas=args.serve_replicas,
         )
         for rec in records:
             print(json.dumps(rec), flush=True)

@@ -179,6 +179,48 @@ def is_committed(path: str) -> bool:
     return True
 
 
+def restore_tree(path: str) -> Any:
+    """Host-side restore of a committed dump WITHOUT a target tree: Orbax
+    reconstructs the saved pytree as numpy leaves, so nothing lands on a
+    device.  This is the registry's background checkpoint load (ISSUE 7)
+    — a candidate model's params stay host-resident until its warmup
+    stage stages them deliberately."""
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.abspath(path))
+
+
+def verify_manifest(path: str, tree: Any = None) -> Dict[str, Any]:
+    """Full verification gate for one committed dump: manifest present,
+    every recorded file at its recorded size (:func:`is_committed`), and
+    the tree digest equal to the manifest checksum.  ``tree`` skips the
+    redundant re-restore when the caller already holds the restored
+    (host) tree; otherwise the digest check restores host-side via
+    :func:`restore_tree` — params never touch a device either way.
+
+    Returns the manifest dict; raises :class:`CheckpointCorrupt` on any
+    failure.  This is the ONE digest path shared by ``load_checkpoint``
+    and the serving registry's swap gate."""
+    path = os.path.abspath(path)
+    man = read_manifest(path)
+    if man is None:
+        raise CheckpointCorrupt(f"{path}: missing or unreadable manifest")
+    if not is_committed(path):
+        raise CheckpointCorrupt(
+            f"{path}: uncommitted or truncated dump (manifest file sizes "
+            f"disagree with what is on disk)"
+        )
+    if man.get("checksum"):
+        if tree is None:
+            tree = restore_tree(path)
+        got = tree_checksum(tree)
+        if got != man["checksum"]:
+            raise CheckpointCorrupt(
+                f"{path}: restored tree checksum {got[:12]}… does not "
+                f"match manifest {str(man['checksum'])[:12]}…"
+            )
+    return man
+
+
 def load_checkpoint(
     prefix: str,
     epoch: int,
@@ -191,15 +233,10 @@ def load_checkpoint(
     )
     ckptr = ocp.StandardCheckpointer()
     restored = ckptr.restore(path, target=jax.device_get(target))
-    if verify:
-        man = read_manifest(path)
-        if man is not None and man.get("checksum"):
-            got = tree_checksum(restored)
-            if got != man["checksum"]:
-                raise CheckpointCorrupt(
-                    f"{path}: restored tree checksum {got[:12]}… does not "
-                    f"match manifest {str(man['checksum'])[:12]}…"
-                )
+    # manifest-less dumps (legacy/external) load unverified by design;
+    # anything WITH a checksum goes through the shared verification gate
+    if verify and read_manifest(path) is not None:
+        verify_manifest(path, tree=restored)
     return restored
 
 

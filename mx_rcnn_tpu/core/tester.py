@@ -90,6 +90,18 @@ class Predictor:
         Kept for callers that want dispatch/force split points."""
         return self._fn(self.params, batch)
 
+    def predict_with(
+        self, params, batch: Dict[str, np.ndarray]
+    ) -> Dict[str, np.ndarray]:
+        """Blocking forward with CALLER-supplied params instead of the
+        bound ones.  Params are a traced jit argument, so a same-
+        structure/shape/dtype tree reuses the compiled executable — this
+        is what makes a hot-swap warmup (ISSUE 7) a validation pass, not
+        a recompile: the registry drives a candidate version through
+        every warmed bucket off the live path, then the swap itself is a
+        pointer assignment to :attr:`params` between batches."""
+        return jax.device_get(self._fn(params, batch))
+
     def input_layouts(self, batch: Dict[str, np.ndarray]):
         """Compiled layouts of the batch argument for this batch's
         shapes, usable as a ``jax.device_put`` target so the transfer

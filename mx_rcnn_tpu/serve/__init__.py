@@ -11,6 +11,10 @@ scale: ``replica`` wraps one runner in a health-gated state machine
 (WARMING → HEALTHY → DEGRADED → DRAINING → RECOVERING) and ``router``
 pools N of them behind the same engine intake with least-loaded
 bucket-affine dispatch, hedging, requeue-never-drop, and load shedding.
+ISSUE 7 adds the model lifecycle: ``registry`` owns versioned model
+state (LOADING → VERIFYING → WARMING → LIVE → RETIRED) with background
+hot-swap, automatic rollback, and multi-tenant ``(model, version)``
+resolution through the same batcher and pool.
 See SERVING.md for the architecture and failure semantics.
 """
 
@@ -26,6 +30,19 @@ from mx_rcnn_tpu.serve.engine import (
     ServingEngine,
 )
 from mx_rcnn_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+from mx_rcnn_tpu.serve.registry import (
+    DEFAULT_MODEL,
+    ModelRegistry,
+    ModelVersion,
+    RegistryError,
+    SwapCancelled,
+    SwapController,
+    SwapError,
+    SwapInProgress,
+    SwapRolledBack,
+    UnknownModel,
+    VersionState,
+)
 from mx_rcnn_tpu.serve.replica import (
     HealthPolicy,
     Replica,
@@ -39,13 +56,17 @@ __all__ = [
     "BucketLadder",
     "BucketOverflow",
     "CompileCache",
+    "DEFAULT_MODEL",
     "DeadlineExceeded",
     "DynamicBatcher",
     "EngineStopped",
     "HealthPolicy",
     "LatencyHistogram",
+    "ModelRegistry",
+    "ModelVersion",
     "NoHealthyReplica",
     "QueueFull",
+    "RegistryError",
     "Replica",
     "ReplicaDrained",
     "ReplicaPool",
@@ -54,4 +75,11 @@ __all__ = [
     "ServeMetrics",
     "ServeRunner",
     "ServingEngine",
+    "SwapCancelled",
+    "SwapController",
+    "SwapError",
+    "SwapInProgress",
+    "SwapRolledBack",
+    "UnknownModel",
+    "VersionState",
 ]

@@ -15,9 +15,12 @@ edge in a real deployment — surfaces it as 429/503 and the client backs
 off).  This mirrors GuardedLoop's philosophy in ``core/resilience.py``:
 fail loudly at the boundary rather than degrade invisibly.
 
-Grouping is strictly per-bucket (one (H, W) canvas per device batch) so
-every released batch pads to a single jit signature; cross-bucket mixing
-would reintroduce the recompile problem the ladder exists to prevent.
+Grouping is strictly per (model, bucket) — one model family and one
+(H, W) canvas per device batch — so every released batch pads to a
+single jit signature; cross-bucket (or cross-model) mixing would
+reintroduce the recompile problem the ladder exists to prevent.  The
+``model`` key is None for single-model deployments, so multi-tenancy
+(ISSUE 7) costs nothing when unused.
 """
 
 from __future__ import annotations
@@ -52,6 +55,7 @@ class Request:
     deadline: Optional[float] = None     # absolute monotonic, or None
     future: Future = field(default_factory=Future)
     picked_t: float = 0.0                # set by next_batch (queue-wait metric)
+    model: Optional[str] = None          # registry model id (None = default)
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -77,7 +81,8 @@ class DynamicBatcher:
         self.max_batch = int(max_batch)
         self.max_linger = float(max_linger)
         self.max_queue = int(max_queue)
-        self._queues: Dict[Tuple[int, int], deque] = {}
+        # keyed (model, bucket): a batch is homogeneous in BOTH
+        self._queues: Dict[Tuple, deque] = {}
         self._count = 0
         self._closed = False
         self._cond = threading.Condition()
@@ -94,7 +99,9 @@ class DynamicBatcher:
                 )
             if not req.enqueue_t:
                 req.enqueue_t = time.monotonic()
-            self._queues.setdefault(req.bucket, deque()).append(req)
+            self._queues.setdefault((req.model, req.bucket), deque()).append(
+                req
+            )
             self._count += 1
             self._cond.notify()
 
@@ -109,11 +116,12 @@ class DynamicBatcher:
             self._cond.notify_all()
 
     # -------------------------------------------------------------- consumer
-    def _oldest_bucket(self) -> Optional[Tuple[int, int]]:
+    def _oldest_bucket(self) -> Optional[Tuple]:
+        """(model, bucket) key whose head request has waited longest."""
         best, best_t = None, None
-        for bucket, q in self._queues.items():
+        for key, q in self._queues.items():
             if q and (best_t is None or q[0].enqueue_t < best_t):
-                best, best_t = bucket, q[0].enqueue_t
+                best, best_t = key, q[0].enqueue_t
         return best
 
     def _release_time(self, head: Request) -> float:
@@ -126,17 +134,18 @@ class DynamicBatcher:
         return cut
 
     def next_batch(self, poll: float = 0.05) -> Optional[List[Request]]:
-        """Block for the next bucket-homogeneous batch (≤ ``max_batch``
-        requests, FIFO within the bucket).  ``None`` = closed + drained."""
+        """Block for the next (model, bucket)-homogeneous batch (≤
+        ``max_batch`` requests, FIFO within the group).  ``None`` =
+        closed + drained."""
         with self._cond:
             while True:
-                bucket = self._oldest_bucket()
-                if bucket is None:
+                key = self._oldest_bucket()
+                if key is None:
                     if self._closed:
                         return None
                     self._cond.wait(timeout=poll)
                     continue
-                q = self._queues[bucket]
+                q = self._queues[key]
                 now = time.monotonic()
                 full = len(q) >= self.max_batch
                 if full or self._closed or now >= self._release_time(q[0]):

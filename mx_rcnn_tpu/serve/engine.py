@@ -117,9 +117,17 @@ class ServingEngine:
         queued work first; ``drain=False`` aborts — queued batches are
         failed instead of dispatched.  Either way every still-pending
         future is resolved (terminal :class:`EngineStopped`) before this
-        returns: no submitter is left blocked on a dead engine."""
+        returns: no submitter is left blocked on a dead engine.
+
+        Swap interlock (ISSUE 7): any in-flight background model swap is
+        cancelled FIRST, waiting for its controller thread to exit — so
+        no orphaned warmup thread survives the engine and no swap-side
+        ``device_put`` runs after stop returns."""
         if not self._started:
             return
+        reg = getattr(self.runner, "registry", None)
+        if reg is not None:
+            reg.cancel_swaps(wait=True)
         if not drain:
             self._aborting = True
         self.batcher.close()
@@ -149,15 +157,27 @@ class ServingEngine:
 
     # ------------------------------------------------------------- client
     def submit(
-        self, im: np.ndarray, deadline_s: Optional[float] = None
+        self,
+        im: np.ndarray,
+        deadline_s: Optional[float] = None,
+        model: Optional[str] = None,
     ) -> Future:
         """Enqueue one image; returns a Future resolving to the
-        per-class detections list.  Raises
-        :class:`~mx_rcnn_tpu.serve.buckets.BucketOverflow` (oversize) or
-        :class:`~mx_rcnn_tpu.serve.batcher.QueueFull` (backpressure)
-        synchronously — both count as ``rejected``."""
+        per-class detections list.  ``model`` selects a registry family
+        (None = the default model — the tenancy request schema).  Raises
+        :class:`~mx_rcnn_tpu.serve.buckets.BucketOverflow` (oversize),
+        :class:`~mx_rcnn_tpu.serve.batcher.QueueFull` (backpressure), or
+        :class:`~mx_rcnn_tpu.serve.registry.UnknownModel` synchronously
+        — all count as ``rejected``."""
         if not self._started:
             raise RuntimeError("engine not started")
+        if model is not None:
+            reg = getattr(self.runner, "registry", None)
+            if reg is not None and not reg.has(model):
+                self.metrics.inc("rejected")
+                from mx_rcnn_tpu.serve.registry import UnknownModel
+
+                raise UnknownModel(model)
         if self._routed:
             # load shedding: scale the effective intake capacity by the
             # pool's healthy fraction — when half the replicas are out,
@@ -176,7 +196,14 @@ class ServingEngine:
             time.monotonic() + deadline_s if deadline_s is not None else None
         )
         try:
-            req = self.runner.make_request(im, deadline=deadline)
+            # model passed only when explicit, so runner fakes/stubs with
+            # the legacy two-arg make_request keep working unchanged
+            if model is None:
+                req = self.runner.make_request(im, deadline=deadline)
+            else:
+                req = self.runner.make_request(
+                    im, deadline=deadline, model=model
+                )
             self.batcher.submit(req)
         except Exception:
             self.metrics.inc("rejected")
@@ -244,11 +271,15 @@ class ServingEngine:
         # runs on a completion-pool worker; the pool's depth slot is
         # released when this returns, unblocking the assembler
         t0 = time.monotonic()
+        model = reqs[0].model
+        # model kwarg only when the batch carries one (legacy runner
+        # fakes keep their run(batch) signature)
+        mkw = {} if model is None else {"model": model}
 
         def attempt_run(attempt: int):
             if attempt:
                 self.metrics.inc("retried")
-            return self.runner.run(batch)
+            return self.runner.run(batch, **mkw)
 
         try:
             if self._routed:
@@ -257,13 +288,16 @@ class ServingEngine:
                 # batch; the tightest live deadline drives the hedge
                 deadlines = [r.deadline for r in reqs if r.deadline is not None]
                 out = self.runner.run(
-                    batch, deadline=min(deadlines) if deadlines else None
+                    batch, deadline=min(deadlines) if deadlines else None,
+                    **mkw,
                 )
             else:
                 out = self.retry.run(attempt_run)
         except Exception as e:
             self.metrics.inc("failed", len(reqs))
             for r in reqs:
+                if model is not None:
+                    self.metrics.record_model(model, ok=False)
                 self._resolve(r, exc=e)
             return
         done = time.monotonic()
@@ -284,15 +318,59 @@ class ServingEngine:
                 continue
             try:
                 dets = self.runner.detections_for(
-                    out, batch, k, orig_hw=r.orig_hw
+                    out, batch, k, orig_hw=r.orig_hw, **mkw
                 )
             except Exception as e:  # postprocess bug: fail this request
                 self.metrics.inc("failed")
+                if model is not None:
+                    self.metrics.record_model(model, ok=False)
                 self._resolve(r, exc=e)
                 continue
             self.metrics.inc("completed")
-            self.metrics.e2e.record(time.monotonic() - r.enqueue_t)
+            e2e_s = time.monotonic() - r.enqueue_t
+            self.metrics.e2e.record(e2e_s)
+            if model is not None:
+                self.metrics.record_model(model, e2e_s)
             self._resolve(r, dets)
+
+    # ----------------------------------------------------------- lifecycle
+    def swap(
+        self,
+        model: str,
+        checkpoint: str,
+        block: bool = False,
+        timeout: Optional[float] = None,
+    ):
+        """Hot-swap ``model`` to ``checkpoint`` while serving: launches a
+        background :class:`~mx_rcnn_tpu.serve.registry.SwapController`
+        (load → verify → warm → commit-between-batches → canary, with
+        automatic rollback) targeting this engine's runner/pool.
+        Returns the controller, or its result dict with ``block=True``
+        (which raises ``SwapRolledBack``/``SwapCancelled`` inline)."""
+        reg = getattr(self.runner, "registry", None)
+        if reg is None:
+            raise RuntimeError(
+                "runner has no model registry — hot-swap needs a "
+                "registry-backed ServeRunner/ReplicaPool"
+            )
+        return reg.swap(
+            model, checkpoint, target=self.runner, block=block,
+            timeout=timeout,
+        )
+
+    def admin(self, line: str):
+        """Operator command surface (``tools/serve.py`` wires it):
+
+        * ``swap <model> <checkpoint_dir>`` — blocking hot-swap
+        * ``models`` — registry snapshot
+        """
+        parts = line.split()
+        if len(parts) == 3 and parts[0] == "swap":
+            return self.swap(parts[1], parts[2], block=True)
+        if parts == ["models"]:
+            reg = getattr(self.runner, "registry", None)
+            return reg.snapshot() if reg is not None else {}
+        raise ValueError(f"unknown admin command: {line!r}")
 
     # ---------------------------------------------------------- reporting
     def snapshot(self) -> Dict:
@@ -301,4 +379,7 @@ class ServingEngine:
             out["completion"] = self._pool.stats()
         if self._routed:
             out["pool"] = self.runner.snapshot()
+        reg = getattr(self.runner, "registry", None)
+        if reg is not None:
+            out["registry"] = reg.snapshot()
         return out

@@ -46,26 +46,42 @@ def run_load(
     deadline_s: Optional[float] = None,
     queue_full_backoff: float = 0.002,
     collect: bool = False,
+    models: Optional[Sequence[str]] = None,
 ) -> Dict:
     """Drive ``engine`` with ``num_requests`` synthetic images; returns a
     report dict (wall/throughput/outcome counts + the engine's metrics
     snapshot).  ``QueueFull`` is the backpressure signal — the client
     backs off and resubmits, counting the rejection.
 
+    ``models`` (optional) assigns each request a model id drawn
+    deterministically from the sequence — the multi-tenancy traffic mix.
+    The draw happens from ``seed`` before any thread starts (same rng
+    stream discipline as sizes), so the (index → model) mapping is
+    identical across runs.
+
     ``collect=True`` additionally stores each request's resolution under
     ``report["_results"]`` — ``{index: ("ok", detections) | (kind, repr)}``
     — which is what lets a faulted run be compared byte-for-byte against
-    an unfaulted one (pop the key before JSON-dumping the report).
-    Because traffic is derived from ``seed + index`` alone, equal indices
-    mean equal input images across runs."""
+    an unfaulted one (pop the key before JSON-dumping the report), plus
+    per-request submit/done monotonic timestamps under
+    ``report["_times"]`` — ``{index: (t_submit, t_done)}`` — which is how
+    the swap bench classifies requests as entirely-before / entirely-
+    after / straddling a live swap window.  Because traffic is derived
+    from ``seed + index`` alone, equal indices mean equal input images
+    across runs."""
     size_rng = np.random.RandomState(seed)
     req_sizes = [
         sizes[size_rng.randint(len(sizes))] for i in range(num_requests)
     ]
+    req_models = (
+        [models[size_rng.randint(len(models))] for _ in range(num_requests)]
+        if models else None
+    )
     counter = iter(range(num_requests))
     lock = threading.Lock()
     outcomes = {"ok": 0, "deadline": 0, "error": 0, "queue_full_retries": 0}
     results: Dict[int, Tuple[str, object]] = {}
+    times: Dict[int, Tuple[float, float]] = {}
 
     def note(key: str) -> None:
         with lock:
@@ -79,9 +95,14 @@ def run_load(
                 return
             h, w = req_sizes[i]
             im = synthetic_image(i, h, w, seed)
+            mkw = (
+                {} if req_models is None or req_models[i] is None
+                else {"model": req_models[i]}
+            )
+            t_submit = time.monotonic()
             while True:
                 try:
-                    fut = engine.submit(im, deadline_s=deadline_s)
+                    fut = engine.submit(im, deadline_s=deadline_s, **mkw)
                     break
                 except QueueFull:
                     note("queue_full_retries")
@@ -98,6 +119,9 @@ def run_load(
                 if collect:
                     with lock:
                         results[i] = (kind, repr(e))
+            if collect:
+                with lock:
+                    times[i] = (t_submit, time.monotonic())
 
     threads = [
         threading.Thread(target=client, name=f"loadgen-{t}", daemon=True)
@@ -121,6 +145,9 @@ def run_load(
         "outcomes": outcomes,
         "engine": snap,
     }
+    if models:
+        report["models"] = list(models)
     if collect:
         report["_results"] = results
+        report["_times"] = times
     return report

@@ -127,10 +127,29 @@ class ServeMetrics:
         # queue depth gauge
         self.queue_depth = 0
         self.queue_depth_max = 0
+        # per-model breakdown (multi-tenancy, ISSUE 7): populated only
+        # for requests that carried an explicit model id, so the
+        # single-model deployment pays nothing and reports nothing extra
+        self.by_model: Dict[str, Dict] = {}
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
             setattr(self, name, getattr(self, name) + n)
+
+    def record_model(self, model: str, e2e_s: Optional[float] = None,
+                     ok: bool = True) -> None:
+        """Per-model completion/failure counters + e2e latency histogram
+        — the tenancy-isolation evidence (model A's swap must not move
+        model B's histogram)."""
+        with self._lock:
+            m = self.by_model.get(model)
+            if m is None:
+                m = self.by_model[model] = {
+                    "completed": 0, "failed": 0, "e2e": LatencyHistogram(),
+                }
+            m["completed" if ok else "failed"] += 1
+        if ok and e2e_s is not None:
+            m["e2e"].record(e2e_s)
 
     def record_batch(self, real: int, slots: int) -> None:
         with self._lock:
@@ -184,6 +203,17 @@ class ServeMetrics:
             "service": self.service.snapshot(),
             "e2e": self.e2e.snapshot(),
         }
+        with self._lock:
+            by_model = dict(self.by_model)
+        if by_model:
+            out["models"] = {
+                mid: {
+                    "completed": m["completed"],
+                    "failed": m["failed"],
+                    "e2e": m["e2e"].snapshot(),
+                }
+                for mid, m in by_model.items()
+            }
         if compile_cache is not None:
             out["compile"] = compile_cache.snapshot()
         return out

@@ -1,0 +1,550 @@
+"""Versioned model registry + live checkpoint hot-swap (ISSUE 7).
+
+Params stop being a constructor argument and become a versioned,
+swappable resource: a :class:`ModelRegistry` owns one :class:`_Entry`
+per model family (the flax module + config + a version history), and
+the serving stack (``runner``/``router``/``engine``) resolves
+``(model_id, version)`` through it on every batch instead of holding a
+params tree of its own.
+
+Each :class:`ModelVersion` moves through the same shape of state
+machine PR 6 gave replicas::
+
+    LOADING ──restore_tree──▶ VERIFYING ──manifest gate ok──▶ WARMING
+                                                                 │
+       RETIRED ◀──superseded by a later swap── LIVE ◀──warm rungs ok,
+          ▲                                     │      commit between
+          │                                     │      batches
+          └── verify/warm/canary failure, ──────┘
+              cancel, or rollback (params
+              reference dropped → device
+              buffers free per PR 4)
+
+A :class:`SwapController` runs one swap on a background thread, fully
+off the predict path:
+
+1. **LOADING** — :func:`~mx_rcnn_tpu.core.checkpoint.restore_tree`
+   restores the checkpoint host-side (numpy leaves, nothing on device).
+2. **VERIFYING** — :func:`~mx_rcnn_tpu.core.checkpoint.verify_manifest`
+   (the same gate ``load_checkpoint`` uses: manifest present, file
+   sizes intact, tree digest equal to the recorded checksum), plus a
+   structure check against the current LIVE version — a tree with
+   different leaf paths/shapes/dtypes would force a recompile at swap
+   time, so it is rejected here instead.
+3. **WARMING** — ``target.warm_version(...)`` drives the candidate
+   params through every (model, bucket) signature the target actually
+   serves via ``Predictor.predict_with`` — params are a traced jit
+   argument, so this reuses the compiled executables (zero new compile
+   misses) and doubles as a numerical smoke test; the staged
+   device-placed tree is parked for the commit.
+4. **commit** — the registry's live pointer flips to the new version;
+   every runner observes the flip at its next ``run()`` and swaps its
+   predictor's params pointer between batches (a request is served
+   entirely by old params or entirely by new params, never a mix).
+5. **canary** — one probe batch per routable replica through the live
+   predict path.  A canary failure rolls the live pointer straight back
+   to the previous version and retires the candidate.
+
+Failures at any stage (including the deterministic ``MX_RCNN_FAULTS``
+injectors ``swap_verify_fail`` / ``swap_warm_fail`` / ``canary_fail``)
+retire the candidate, release its params reference, and surface
+:class:`SwapRolledBack` on the controller's future; the previous LIVE
+version keeps serving throughout.  ``ServingEngine.stop`` calls
+:meth:`ModelRegistry.cancel_swaps` first, so an in-flight swap cancels
+cleanly — the abort hook raises between warm rungs, before any further
+``device_put``.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from mx_rcnn_tpu.core.checkpoint import restore_tree, verify_manifest
+from mx_rcnn_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
+
+#: model id used when a runner is built legacy-style (model+params in the
+#: constructor) and for requests that carry no model id
+DEFAULT_MODEL = "default"
+
+
+class RegistryError(RuntimeError):
+    """Invalid registry operation (duplicate registration, no live
+    version, …)."""
+
+
+class UnknownModel(KeyError):
+    """A request or swap referenced a model id nobody registered."""
+
+
+class SwapError(RuntimeError):
+    """A swap failed outright (bad structure, no capacity, …)."""
+
+
+class SwapInProgress(SwapError):
+    """At most one in-flight swap per model: a second ``swap`` on the
+    same model while one is running is an operator error, not a queue."""
+
+
+class SwapCancelled(SwapError):
+    """The swap was cancelled (engine stop / operator) before commit —
+    the previous LIVE version was never at risk."""
+
+
+class SwapRolledBack(SwapError):
+    """The swap failed at a gate and the previous LIVE version is (still
+    or again) serving.  ``stage`` says where: "verify" and "warm" fail
+    before commit (the candidate never served a request); "canary" fails
+    after commit and the live pointer was rolled back between batches."""
+
+    def __init__(self, stage: str, cause: BaseException):
+        super().__init__(f"swap rolled back at {stage} stage: {cause!r}")
+        self.stage = stage
+        self.cause = cause
+
+
+class VersionState(enum.Enum):
+    LOADING = "loading"
+    VERIFYING = "verifying"
+    WARMING = "warming"
+    LIVE = "live"
+    RETIRED = "retired"
+
+
+class ModelVersion:
+    """One immutable-params version of one model family."""
+
+    def __init__(
+        self,
+        model_id: str,
+        version: int,
+        params: Any = None,
+        digest: Optional[str] = None,
+        source: str = "init",
+        state: VersionState = VersionState.LOADING,
+    ):
+        self.model_id = model_id
+        self.version = int(version)
+        self.params = params
+        self.digest = digest
+        self.source = source
+        self.state = state
+        self.transitions: List[Dict[str, Any]] = []
+        self._t0 = time.monotonic()
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "model": self.model_id,
+            "version": self.version,
+            "state": self.state.value,
+            "source": self.source,
+            "digest": (self.digest[:12] if self.digest else None),
+            "released": self.params is None,
+            "transitions": list(self.transitions),
+        }
+
+
+class _Entry:
+    """Registry row for one model family: the (stateless) flax module,
+    its config, and the version history with a live pointer."""
+
+    def __init__(self, model_id: str, model: Any, cfg: Any):
+        self.model_id = model_id
+        self.model = model
+        self.cfg = cfg
+        self.versions: List[ModelVersion] = []
+        self.live: Optional[ModelVersion] = None
+        self.next_version = 1
+
+
+class ModelRegistry:
+    """Owner of every model family's versioned, swappable params."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._swaps: Dict[str, "SwapController"] = {}
+        self._swap_ordinal = 0
+        # lifecycle counters (merged into pool/engine snapshots)
+        self.swaps_started = 0
+        self.swaps_completed = 0
+        self.swaps_rolled_back = 0
+        self.swaps_cancelled = 0
+        self.versions_released = 0
+
+    # ----------------------------------------------------------- versions
+    def _transition(
+        self, ver: ModelVersion, state: VersionState, reason: str
+    ) -> None:
+        with self._lock:
+            old = ver.state
+            ver.state = state
+            ver.transitions.append(
+                {
+                    "t": round(time.monotonic() - ver._t0, 4),
+                    "from": old.value,
+                    "to": state.value,
+                    "reason": reason,
+                }
+            )
+        logger.info(
+            "model %s v%d: %s -> %s (%s)",
+            ver.model_id, ver.version, old.value, state.value, reason,
+        )
+
+    def _retire(self, ver: ModelVersion, reason: str) -> None:
+        """Terminal: drop the params reference so the host tree — and,
+        once every runner has synced past it, the device buffers staged
+        from it — become collectible (PR 4's free-the-retired-buffers
+        discipline)."""
+        with self._lock:
+            if ver.state is VersionState.RETIRED:
+                return
+            self._transition(ver, VersionState.RETIRED, reason)
+            if ver.params is not None:
+                ver.params = None
+                self.versions_released += 1
+
+    # ------------------------------------------------------------- models
+    def register(
+        self,
+        model_id: str,
+        model: Any,
+        cfg: Any,
+        params: Any,
+        digest: Optional[str] = None,
+        source: str = "init",
+    ) -> ModelVersion:
+        """Add a model family with its v1 params (already loaded and
+        trusted by the caller — the CLI verifies checkpoint sources
+        before registering).  v1 goes straight to LIVE; later versions
+        arrive only through :meth:`swap` and walk the full gate."""
+        with self._lock:
+            if model_id in self._entries:
+                raise RegistryError(f"model {model_id!r} already registered")
+            e = _Entry(model_id, model, cfg)
+            v = ModelVersion(
+                model_id, e.next_version, params=params, digest=digest,
+                source=source, state=VersionState.LOADING,
+            )
+            e.next_version += 1
+            self._transition(v, VersionState.LIVE, "register")
+            e.versions.append(v)
+            e.live = v
+            self._entries[model_id] = e
+            return v
+
+    def has(self, model_id: str) -> bool:
+        with self._lock:
+            return model_id in self._entries
+
+    def model_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)
+
+    @property
+    def default_model(self) -> str:
+        """First-registered model — what a model-less request resolves
+        to."""
+        with self._lock:
+            if not self._entries:
+                raise RegistryError("registry is empty")
+            return next(iter(self._entries))
+
+    def entry(self, model_id: Optional[str] = None) -> _Entry:
+        with self._lock:
+            mid = self.default_model if model_id is None else model_id
+            e = self._entries.get(mid)
+            if e is None:
+                raise UnknownModel(mid)
+            return e
+
+    def live(self, model_id: Optional[str] = None) -> ModelVersion:
+        """The version currently serving ``model_id`` — the single
+        pointer every runner compares against on each batch."""
+        with self._lock:
+            e = self.entry(model_id)
+            if e.live is None:
+                raise RegistryError(f"model {e.model_id!r} has no live version")
+            return e.live
+
+    # -------------------------------------------------------------- swaps
+    def swap(
+        self,
+        model_id: str,
+        checkpoint: str,
+        target: Any,
+        block: bool = False,
+        timeout: Optional[float] = None,
+    ) -> Any:
+        """Launch a background load→verify→warm→commit→canary swap of
+        ``model_id`` to ``checkpoint`` on ``target`` (a ServeRunner or a
+        ReplicaPool — anything with ``warm_version``/``canary``).
+        Returns the :class:`SwapController` (or, with ``block=True``,
+        its result — raising :class:`SwapRolledBack` etc. inline)."""
+        with self._lock:
+            e = self.entry(model_id)
+            prev = self._swaps.get(e.model_id)
+            if prev is not None and not prev.done():
+                raise SwapInProgress(
+                    f"model {e.model_id!r} already has a swap in flight"
+                )
+            self._swap_ordinal += 1
+            self.swaps_started += 1
+            ctrl = SwapController(
+                self, e, checkpoint, target, ordinal=self._swap_ordinal
+            )
+            self._swaps[e.model_id] = ctrl
+        ctrl.start()
+        if block:
+            return ctrl.result(timeout)
+        return ctrl
+
+    def swaps_in_flight(self) -> int:
+        with self._lock:
+            return sum(1 for c in self._swaps.values() if not c.done())
+
+    def cancel_swaps(self, wait: bool = True) -> int:
+        """Cancel every in-flight swap; with ``wait`` (the engine-stop
+        interlock) block until the controller threads have exited — no
+        orphaned warmup thread survives, and no device_put runs after
+        this returns.  Returns how many were still in flight."""
+        with self._lock:
+            ctrls = [c for c in self._swaps.values() if not c.done()]
+        for c in ctrls:
+            c.cancel()
+        if wait:
+            for c in ctrls:
+                c.join()
+        return len(ctrls)
+
+    # ------------------------------------------------------ observability
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            models = {
+                mid: {
+                    "live_version": e.live.version if e.live else None,
+                    "versions": [v.snapshot() for v in e.versions],
+                    "swap_in_flight": (
+                        mid in self._swaps and not self._swaps[mid].done()
+                    ),
+                }
+                for mid, e in self._entries.items()
+            }
+            return {
+                "models": models,
+                "swaps": {
+                    "started": self.swaps_started,
+                    "completed": self.swaps_completed,
+                    "rolled_back": self.swaps_rolled_back,
+                    "cancelled": self.swaps_cancelled,
+                    "in_flight": sum(
+                        1 for c in self._swaps.values() if not c.done()
+                    ),
+                },
+                "versions_released": self.versions_released,
+            }
+
+
+def _tree_signature(tree: Any) -> List:
+    """(path, shape, dtype) per leaf — the structure a swap must preserve
+    so the existing compiled executables remain valid."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [
+        (
+            jax.tree_util.keystr(path),
+            tuple(getattr(leaf, "shape", ())),
+            str(getattr(leaf, "dtype", type(leaf).__name__)),
+        )
+        for path, leaf in leaves
+    ]
+
+
+class SwapController:
+    """One background swap: a thread walking the candidate version
+    through the LOADING→VERIFYING→WARMING→LIVE gauntlet with rollback.
+
+    ``future`` resolves exactly once: a result dict on success, or
+    :class:`SwapRolledBack` / :class:`SwapCancelled` / :class:`SwapError`.
+    """
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        entry: _Entry,
+        checkpoint: str,
+        target: Any,
+        ordinal: int,
+    ):
+        self.registry = registry
+        self.entry = entry
+        self.checkpoint = checkpoint
+        self.target = target
+        self.ordinal = int(ordinal)
+        self.future: "Future" = Future()
+        self._cancel = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"swap-{entry.model_id}-{ordinal}",
+            daemon=True,
+        )
+
+    # ----------------------------------------------------------- control
+    def start(self) -> "SwapController":
+        self._thread.start()
+        return self
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    def done(self) -> bool:
+        return self.future.done()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    def result(self, timeout: Optional[float] = None):
+        return self.future.result(timeout)
+
+    def _abort_check(self) -> None:
+        """Passed into ``target.warm_version`` and called between stages:
+        raising here (instead of polling a flag at the call sites) means
+        a cancelled swap stops BEFORE its next device_put, which is the
+        engine-stop interlock's contract."""
+        if self._cancel.is_set():
+            raise SwapCancelled(
+                f"swap #{self.ordinal} of model {self.entry.model_id!r} "
+                f"cancelled"
+            )
+
+    # ------------------------------------------------------------- stages
+    def _run(self) -> None:
+        reg, e = self.registry, self.entry
+        ver: Optional[ModelVersion] = None
+        stage = "load"
+        try:
+            old = reg.live(e.model_id)
+            with reg._lock:
+                ver = ModelVersion(
+                    e.model_id, e.next_version, source=str(self.checkpoint),
+                )
+                e.next_version += 1
+                e.versions.append(ver)
+            self._abort_check()
+
+            # LOADING: host-side restore, nothing on device
+            tree = restore_tree(self.checkpoint)
+            self._abort_check()
+
+            # VERIFYING: shared manifest gate + structure-vs-live check
+            stage = "verify"
+            reg._transition(ver, VersionState.VERIFYING, "loaded")
+            man = verify_manifest(self.checkpoint, tree=tree)
+            faults.swap_fault("verify", self.ordinal)
+            params = (
+                tree["params"]
+                if isinstance(tree, dict) and "params" in tree
+                else tree
+            )
+            got, want = _tree_signature(params), _tree_signature(old.params)
+            if got != want:
+                raise SwapError(
+                    f"checkpoint tree structure does not match live "
+                    f"v{old.version} ({len(got)} vs {len(want)} leaves or "
+                    f"mismatched shapes/dtypes) — a swap must not force a "
+                    f"recompile"
+                )
+            ver.params = params
+            ver.digest = man.get("checksum")
+            self._abort_check()
+
+            # WARMING: candidate params through every served signature,
+            # off the live path (predict_with — zero new compiles)
+            stage = "warm"
+            reg._transition(ver, VersionState.WARMING, "verified")
+            warmed = self.target.warm_version(
+                e.model_id, ver.version, params, abort=self._abort_check
+            )
+            faults.swap_fault("warm", self.ordinal)
+            self._abort_check()
+
+            # commit: flip the live pointer; runners swap between batches
+            with reg._lock:
+                self._abort_check()
+                reg._transition(ver, VersionState.LIVE, "swap commit")
+                e.live = ver
+
+            # canary: live-path probes; failure rolls the pointer back
+            stage = "canary"
+            try:
+                probed = self.target.canary(e.model_id)
+                faults.swap_fault("canary", self.ordinal)
+            except Exception as ce:
+                with reg._lock:
+                    e.live = old
+                reg._retire(ver, f"canary failed — rolled back: {ce!r}")
+                self._discard(ver)
+                with reg._lock:
+                    reg.swaps_rolled_back += 1
+                raise SwapRolledBack("canary", ce) from ce
+
+            reg._retire(old, f"superseded by v{ver.version}")
+            with reg._lock:
+                reg.swaps_completed += 1
+            self.future.set_result(
+                {
+                    "model": e.model_id,
+                    "version": ver.version,
+                    "previous": old.version,
+                    "warmed": warmed,
+                    "canary_probes": probed,
+                    "digest": ver.digest,
+                }
+            )
+        except SwapCancelled as exc:
+            if ver is not None:
+                self._rollback_uncommitted(ver, old, "cancelled")
+                self._discard(ver)
+            with reg._lock:
+                reg.swaps_cancelled += 1
+            self.future.set_exception(exc)
+        except SwapRolledBack as exc:
+            self.future.set_exception(exc)
+        except Exception as exc:  # noqa: BLE001 — every gate failure rolls back
+            if ver is not None:
+                self._rollback_uncommitted(ver, old, f"{stage} failed: {exc!r}")
+                self._discard(ver)
+            with reg._lock:
+                reg.swaps_rolled_back += 1
+            self.future.set_exception(SwapRolledBack(stage, exc))
+
+    def _rollback_uncommitted(
+        self, ver: ModelVersion, old: ModelVersion, reason: str
+    ) -> None:
+        """Retire a candidate that failed before (or during) commit; if
+        the live pointer already moved to it, point back at ``old``."""
+        reg = self.registry
+        with reg._lock:
+            if self.entry.live is ver:
+                self.entry.live = old
+        reg._retire(ver, reason)
+
+    def _discard(self, ver: ModelVersion) -> None:
+        """Drop any device-staged buffers the target parked for this
+        version (best-effort: a fake target in tests may not stage)."""
+        discard = getattr(self.target, "discard_version", None)
+        if discard is not None:
+            try:
+                discard(ver.model_id, ver.version)
+            except Exception:  # noqa: BLE001 — discard is cleanup, not a gate
+                logger.exception(
+                    "discard_version(%s, %d) failed", ver.model_id, ver.version
+                )

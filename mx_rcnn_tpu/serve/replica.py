@@ -130,6 +130,7 @@ class _Dispatch:
     kind: str = "serve"  # "serve" | "probe"
     future: Future = field(default_factory=Future)
     ordinal: int = -1    # per-replica batch ordinal, set at predict time
+    model: Optional[str] = None  # registry model id (None = default)
 
     def resolve(self, result=None, exc: Optional[BaseException] = None) -> bool:
         """Set the future if still unset; False when it already resolved
@@ -181,6 +182,8 @@ class Replica:
         self.abandoned = 0      # results that arrived after the failover
         self.probes = 0
         self.rewarms = 0
+        self.partial_rewarms = 0     # recoveries warmed from traffic history
+        self.last_rewarm_rungs = 0   # rungs the last partial rewarm compiled
         self.breaker_opens = 0
         self.last_backoff = 0.0
         self._t0 = time.monotonic()
@@ -228,12 +231,13 @@ class Replica:
         self,
         batch: Dict[str, np.ndarray],
         deadline: Optional[float] = None,
+        model: Optional[str] = None,
     ) -> _Dispatch:
         """Enqueue one batch; returns the dispatch whose future resolves
         exactly once.  A non-routable replica fails it immediately with
         :class:`ReplicaDrained` instead of accepting work it would only
         drain later."""
-        d = _Dispatch(batch=batch, deadline=deadline)
+        d = _Dispatch(batch=batch, deadline=deadline, model=model)
         with self._lock:
             if self._stop or self.state not in (
                 ReplicaState.HEALTHY, ReplicaState.DEGRADED
@@ -303,11 +307,16 @@ class Replica:
             self._watchdog.cancel()
             self._watchdog = None
 
-    def _predict(self, batch, ordinal: int, attempt: int):
+    def _predict(self, batch, ordinal: int, attempt: int,
+                 model: Optional[str] = None):
         if attempt:
             self.retried += 1
         faults.predict_fault(self.index, ordinal)
-        return self.runner.run(batch)
+        # model kwarg only when the dispatch carries one, so runner
+        # fakes with the legacy run(batch) signature keep working
+        if model is None:
+            return self.runner.run(batch)
+        return self.runner.run(batch, model=model)
 
     def _serve(self, d: _Dispatch) -> None:
         with self._lock:
@@ -327,7 +336,9 @@ class Replica:
         t0 = time.monotonic()
         try:
             out = self.policy.retry.run(
-                lambda attempt: self._predict(d.batch, d.ordinal, attempt)
+                lambda attempt: self._predict(
+                    d.batch, d.ordinal, attempt, model=d.model
+                )
             )
         except Exception as e:  # noqa: BLE001 — typed failover, never a drop
             self._disarm_watchdog()
@@ -446,10 +457,34 @@ class Replica:
             try:
                 if not initial:
                     # a REAL recompile: fresh runner (new jit callables,
-                    # new compile cache), then rewarm the whole ladder
+                    # new compile cache) — but rewarm only the (model,
+                    # bucket) signatures this replica ACTUALLY served
+                    # (ISSUE 7 per-bucket warm partitioning); anything it
+                    # never saw warms lazily on first dispatch.  Falls
+                    # back to the full ladder when there is no traffic
+                    # history or the runner predates the buckets= kwarg.
+                    served = {
+                        m: set(bs)
+                        for m, bs in getattr(
+                            self.runner, "served_buckets", {}
+                        ).items()
+                        if bs
+                    }
                     self.runner = self._factory(self.index)
                     self.rewarms += 1
-                self.runner.warmup()
+                    if served:
+                        try:
+                            self.runner.warmup(buckets=served)
+                            self.partial_rewarms += 1
+                            self.last_rewarm_rungs = sum(
+                                len(b) for b in served.values()
+                            )
+                        except TypeError:
+                            self.runner.warmup()
+                    else:
+                        self.runner.warmup()
+                else:
+                    self.runner.warmup()
             except Exception as e:  # noqa: BLE001 — keep the replica parked
                 self.failures += 1
                 logger.error("replica %d: rewarm failed: %r", self.index, e)
@@ -509,6 +544,8 @@ class Replica:
             "abandoned": self.abandoned,
             "probes": self.probes,
             "rewarms": self.rewarms,
+            "partial_rewarms": self.partial_rewarms,
+            "last_rewarm_rungs": self.last_rewarm_rungs,
             "breaker_opens": self.breaker_opens,
             "last_backoff_s": round(self.last_backoff, 4),
             "ewma_ms": (

@@ -147,15 +147,38 @@ class ReplicaPool:
     def compile_cache(self) -> _MergedCompileCache:
         return _MergedCompileCache(self)
 
-    def make_request(self, im, deadline: Optional[float] = None):
-        return self._ref.make_request(im, deadline)
+    @property
+    def registry(self):
+        """The shared model registry when the replicas are registry-
+        backed (every replica resolves the same live pointers), else
+        None (legacy single-model fakes)."""
+        return getattr(self._ref, "registry", None)
+
+    @property
+    def served_buckets(self):
+        """Pool-merged (model → buckets) traffic history."""
+        merged: Dict[str, set] = {}
+        for r in self.replicas:
+            for m, bs in getattr(r.runner, "served_buckets", {}).items():
+                merged.setdefault(m, set()).update(bs)
+        return merged
+
+    def make_request(self, im, deadline: Optional[float] = None, model=None):
+        if model is None:
+            return self._ref.make_request(im, deadline)
+        return self._ref.make_request(im, deadline, model=model)
 
     def assemble(self, requests):
         return self._ref.assemble(requests)
 
-    def detections_for(self, out, batch, index, orig_hw=None, thresh=None):
+    def detections_for(self, out, batch, index, orig_hw=None, thresh=None,
+                       model=None):
+        if model is None:
+            return self._ref.detections_for(
+                out, batch, index, orig_hw=orig_hw, thresh=thresh
+            )
         return self._ref.detections_for(
-            out, batch, index, orig_hw=orig_hw, thresh=thresh
+            out, batch, index, orig_hw=orig_hw, thresh=thresh, model=model
         )
 
     def warmup(self, timeout: float = 300.0) -> int:
@@ -171,15 +194,64 @@ class ReplicaPool:
                 time.sleep(0.01)
         return self.compile_cache.misses
 
+    # -------------------------------------------- swap target surface
+    # The SwapController treats the pool exactly like a single runner:
+    # warm the candidate everywhere, canary the live path, free retired
+    # buffers everywhere.  Fan-out is sequential — a swap is a control-
+    # plane operation and correctness (every replica staged before the
+    # pointer flips) beats warm-phase latency.
+    def warm_version(self, model, version, params, buckets=None,
+                     abort=None) -> int:
+        """Warm candidate ``params`` on EVERY replica (skipping ones with
+        no runner yet, i.e. mid-recovery — their rebuild resolves the
+        live pointer itself).  Returns total rungs warmed pool-wide."""
+        warmed = 0
+        for r in self.replicas:
+            runner = r.runner
+            if runner is None or not hasattr(runner, "warm_version"):
+                continue
+            warmed += runner.warm_version(
+                model, version, params, buckets=buckets, abort=abort
+            )
+        return warmed
+
+    def canary(self, model=None) -> int:
+        """One live-path probe per routable replica; returns probes run.
+        Raises when no replica is routable or any probe fails (the
+        SwapController rolls the live pointer back)."""
+        probed = 0
+        for r in self.replicas:
+            if not r.routable:
+                continue
+            r.runner.canary(model)
+            probed += 1
+        if probed == 0:
+            raise NoHealthyReplica("no routable replica for swap canary")
+        return probed
+
+    def discard_version(self, model, version) -> None:
+        """Drop every replica's staged/cached device tree for a retired
+        version (PR 4 discipline: retired buffers free promptly)."""
+        for r in self.replicas:
+            runner = r.runner
+            if runner is not None and hasattr(runner, "discard_version"):
+                runner.discard_version(model, version)
+
     # ------------------------------------------------------- routing
     def healthy_fraction(self) -> float:
         n = sum(1 for r in self.replicas if r.routable)
         return n / len(self.replicas)
 
     def _pick(
-        self, bucket: Tuple[int, int], exclude: Tuple[int, ...] = ()
+        self,
+        bucket: Tuple[int, int],
+        exclude: Tuple[int, ...] = (),
+        model: Optional[str] = None,
     ) -> Optional[Replica]:
-        affinity = hash(bucket)
+        # affinity over (model, bucket): under even load each model's
+        # bucket keeps hitting the same replica, so multi-tenancy does
+        # not spread every family's signatures across the whole pool
+        affinity = hash((model, bucket))
         n = len(self.replicas)
         best = None
         best_key = None
@@ -207,11 +279,14 @@ class ReplicaPool:
         self,
         batch: Dict[str, np.ndarray],
         deadline: Optional[float] = None,
+        model: Optional[str] = None,
     ) -> Dict[str, np.ndarray]:
         """Predict ``batch`` on some healthy replica: least-loaded pick,
         hedge past the timeout, requeue on drain, fail over on error.
-        Raises :class:`NoHealthyReplica` when the pool has no capacity,
-        or the last replica error after bounded failover."""
+        ``model`` keys the affinity and rides the dispatch down to the
+        replica's runner.  Raises :class:`NoHealthyReplica` when the
+        pool has no capacity, or the last replica error after bounded
+        failover."""
         bucket = tuple(batch["images"].shape[1:3])
         t0 = time.monotonic()
         attempts = 0
@@ -220,15 +295,15 @@ class ReplicaPool:
         exclude: Tuple[int, ...] = ()
         while attempts < max_attempts:
             attempts += 1
-            primary = self._pick(bucket, exclude)
+            primary = self._pick(bucket, exclude, model=model)
             if primary is None and exclude:
                 # every sibling already failed this batch — retry the
                 # excluded set before giving up (a replica may have
                 # recovered, and a transient error deserves a second lap)
                 exclude = ()
-                primary = self._pick(bucket)
+                primary = self._pick(bucket, model=model)
             if primary is None:
-                primary = self._wait_for_healthy(bucket)
+                primary = self._wait_for_healthy(bucket, model=model)
             if primary is None:
                 with self._lock:
                     self.no_healthy += 1
@@ -237,7 +312,7 @@ class ReplicaPool:
                 ) from last_exc
             with self._lock:
                 self.dispatched += 1
-            d = primary.submit(batch, deadline)
+            d = primary.submit(batch, deadline, model=model)
             try:
                 out = d.future.result(timeout=self._hedge_s(deadline))
                 self._done(t0)
@@ -248,7 +323,9 @@ class ReplicaPool:
                 last_exc = e
                 continue  # replica tripped mid-flight: requeue elsewhere
             except FutureTimeout:
-                out = self._race_hedge(batch, bucket, deadline, primary, d)
+                out = self._race_hedge(
+                    batch, bucket, deadline, primary, d, model=model
+                )
                 if out is not None:
                     self._done(t0)
                     return out
@@ -266,33 +343,33 @@ class ReplicaPool:
             "routing attempts exhausted"
         )
 
-    def _wait_for_healthy(self, bucket) -> Optional[Replica]:
+    def _wait_for_healthy(self, bucket, model=None) -> Optional[Replica]:
         """Brief bounded poll for a recovering pool before declaring
         zero capacity (a drained replica often rejoins within ms on the
         breaker's first lap)."""
         t_end = time.monotonic() + self.no_healthy_wait
         while time.monotonic() < t_end:
             time.sleep(0.01)
-            r = self._pick(bucket)
+            r = self._pick(bucket, model=model)
             if r is not None:
                 return r
         return None
 
-    def _race_hedge(self, batch, bucket, deadline, primary, d):
+    def _race_hedge(self, batch, bucket, deadline, primary, d, model=None):
         """Primary exceeded the hedge timeout: dispatch the same batch to
         a second replica and race.  Returns the first success, or None
         when both legs fail.  The losing leg's result is discarded by its
         replica (resolve-once dispatch future → ``abandoned``)."""
         with self._lock:
             self.hedged += 1
-        backup = self._pick(bucket, exclude=(primary.index,))
+        backup = self._pick(bucket, exclude=(primary.index,), model=model)
         if backup is None:
             # nowhere to hedge: keep waiting on the primary alone
             try:
                 return d.future.result()
             except Exception:  # noqa: BLE001
                 return None
-        d2 = backup.submit(batch, deadline)
+        d2 = backup.submit(batch, deadline, model=model)
         futures = {d.future: "primary", d2.future: "hedge"}
         while futures:
             done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
@@ -334,7 +411,7 @@ class ReplicaPool:
                 "failovers": self.failovers,
                 "no_healthy": self.no_healthy,
             }
-        return {
+        out = {
             "replicas": per,
             "states": {r.index: r.state.value for r in self.replicas},
             "healthy_fraction": round(self.healthy_fraction(), 4),
@@ -345,19 +422,35 @@ class ReplicaPool:
             },
             "compile": self.compile_cache.snapshot(),
         }
+        reg = self.registry
+        if reg is not None:
+            out["registry"] = reg.snapshot()
+        return out
 
 
 def make_replica_factory(
     build_runner: Callable[..., Any],
-    params,
+    params=None,
     devices: Optional[List] = None,
+    registry=None,
     **runner_kwargs,
 ) -> Callable[[int], Any]:
-    """Runner factory that pins each replica's params to its own device.
+    """Runner factory that pins each replica's state to its own device.
 
-    ``jax.device_put(params, device)`` yields COMMITTED arrays, so every
-    jit the replica's Predictor traces executes on that device — replica
-    i's compute never contends with replica j's.  ``devices`` defaults to
+    Two modes:
+
+    * **legacy (``params``)** — ``jax.device_put(params, device)`` yields
+      COMMITTED arrays, so every jit the replica's Predictor traces
+      executes on that device — replica i's compute never contends with
+      replica j's.
+    * **registry (``registry``)** — no params are captured in the
+      closure; each runner gets ``registry=registry, device=device`` and
+      resolves the CURRENT live version itself at build time.  This is
+      what makes recovery swap-correct: a replica rebuilt after a swap
+      warms the new live params, never a stale snapshot pinned at pool
+      construction.
+
+    ``devices`` defaults to
     :func:`mx_rcnn_tpu.parallel.mesh.replica_slices` round-robin over the
     local device set (8 virtual CPU devices in tests).
     """
@@ -365,9 +458,16 @@ def make_replica_factory(
 
     from mx_rcnn_tpu.parallel import mesh
 
+    if (params is None) == (registry is None):
+        raise ValueError("pass exactly one of params= or registry=")
+
     def factory(index: int):
         devs = devices if devices is not None else mesh.replica_slices()
         device = devs[index % len(devs)]
+        if registry is not None:
+            return build_runner(
+                registry=registry, device=device, **runner_kwargs
+            )
         pinned = jax.device_put(params, device)
         return build_runner(params=pinned, **runner_kwargs)
 

@@ -22,6 +22,7 @@ ladder once, after which ``misses`` must stay 0.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -120,6 +121,7 @@ def prepare_request(
     cfg: Config,
     ladder: BucketLadder,
     deadline: Optional[float] = None,
+    model: Optional[str] = None,
 ) -> Request:
     """Original RGB image → bucket-padded :class:`Request`.
 
@@ -147,18 +149,61 @@ def prepare_request(
         bucket=bucket,
         enqueue_t=time.monotonic(),
         deadline=deadline,
+        model=model,
     )
 
 
 # ------------------------------------------------------------------ runner
+class _ModelSlot:
+    """One model family's device-facing state on one runner: the jitted
+    :class:`Predictor` bound to whatever version this runner last synced
+    to.  ``lock`` serializes the params pointer swap against concurrent
+    sync attempts; predict itself reads the pointer once, so a swap
+    lands cleanly BETWEEN batches."""
+
+    def __init__(self, model_id, predictor, version, cfg, num_classes,
+                 uint8: bool):
+        self.model_id = model_id
+        self.predictor = predictor
+        self.version = int(version)
+        self.cfg = cfg
+        self.num_classes = int(num_classes)
+        self.uint8 = bool(uint8)
+        self.lock = threading.Lock()
+
+
 class ServeRunner:
-    """Device-facing predict path shared by the engine, bench, and tests."""
+    """Device-facing predict path shared by the engine, bench, and tests.
+
+    Since ISSUE 7 the runner holds NO params of its own: every model's
+    params are a versioned resource in a
+    :class:`~mx_rcnn_tpu.serve.registry.ModelRegistry`, resolved per
+    batch.  Two construction modes:
+
+    * legacy single-model — ``ServeRunner(model, params, cfg, ...)``
+      builds a private one-entry registry under
+      :data:`~mx_rcnn_tpu.serve.registry.DEFAULT_MODEL` (every pre-ISSUE-7
+      call site works unchanged);
+    * tenancy — ``ServeRunner(registry=reg, ...)`` serves every family
+      in a shared registry; requests carry ``model=`` and each family
+      gets its own :class:`_ModelSlot` (own jit, own postprocess, own
+      uint8/num_classes), all accounted in ONE compile cache keyed
+      ``(model, shape, dtype)``.
+
+    Hot-swap contract: ``run`` compares its slot's version against the
+    registry's live pointer and, on mismatch, swaps the predictor's
+    params pointer under the slot lock — params are a traced jit
+    argument, so a same-structure swap reuses the compiled executable
+    (zero recompiles) and takes effect between batches.  ``warm_version``
+    stages a candidate's device placement ahead of the commit;
+    ``canary`` probes the live path after it.
+    """
 
     def __init__(
         self,
-        model,
-        params,
-        cfg: Config,
+        model=None,
+        params=None,
+        cfg: Optional[Config] = None,
         num_classes: Optional[int] = None,
         ladder: Optional[BucketLadder] = None,
         max_batch: int = 4,
@@ -166,16 +211,33 @@ class ServeRunner:
         device_postprocess: Optional[bool] = None,
         deterministic: bool = False,
         layout_feed: Optional[bool] = None,
+        registry=None,
+        device=None,
     ):
-        self.cfg = cfg
+        from mx_rcnn_tpu.serve.registry import DEFAULT_MODEL, ModelRegistry
+
+        if registry is None:
+            if model is None or params is None or cfg is None:
+                raise ValueError(
+                    "ServeRunner needs (model, params, cfg) or registry="
+                )
+            registry = ModelRegistry()
+            registry.register(DEFAULT_MODEL, model, cfg, params)
+        self.registry = registry
+        self.device = device
+        self.default_model = registry.default_model
+        self.cfg = cfg if cfg is not None else registry.entry(
+            self.default_model
+        ).cfg
+        self._num_classes_override = num_classes
         self.num_classes = (
-            cfg.dataset.NUM_CLASSES if num_classes is None else num_classes
+            self.cfg.dataset.NUM_CLASSES if num_classes is None else num_classes
         )
         self.ladder = ladder if ladder is not None else BucketLadder(
-            cfg.SHAPE_BUCKETS
+            self.cfg.SHAPE_BUCKETS
         )
         self.max_batch = int(max_batch)
-        self.uint8 = bool(cfg.TEST.UINT8_TRANSFER)
+        self.uint8 = bool(self.cfg.TEST.UINT8_TRANSFER)
         self.compile_cache = CompileCache()
         if donate is None:
             # donation only pays (and only works) on accelerator backends;
@@ -188,37 +250,119 @@ class ServeRunner:
             # are trivial there and the probe would double every compile
             layout_feed = jax.default_backend() != "cpu"
         self.layout_feed = bool(layout_feed)
-        self._layouts: Dict[Tuple, object] = {}  # warmup-captured, per bucket
+        self._donate = bool(donate)
+        self._deterministic = bool(deterministic)
+        self._device_postprocess = device_postprocess
+        self._layouts: Dict[Tuple, object] = {}  # warmup-captured, per sig
         self.staged_batches = 0
         self.layout_staged = 0
-        post = None
-        if (
-            cfg.TEST.DEVICE_POSTPROCESS
-            if device_postprocess is None
-            else device_postprocess
-        ) and not cfg.network.USE_MASK:
-            from mx_rcnn_tpu.ops.postprocess import make_test_postprocess
+        # registry-resolution state
+        self._slots: Dict[str, _ModelSlot] = {}
+        self._slots_lock = threading.Lock()
+        self._staged: Dict[Tuple[str, int], object] = {}  # (model, ver) → tree
+        self.served_buckets: Dict[str, set] = {}
+        self.swaps_applied = 0
+        # build the default slot eagerly: construction fails fast on a
+        # bad config, and legacy callers read .predictor immediately
+        self._slot(self.default_model)
 
-            post = make_test_postprocess(
-                cfg,
-                self.num_classes,
-                cfg.TEST.SCORE_THRESH,
-                max_out=cfg.TEST.DET_PER_CLASS,
+    # ---- registry resolution
+    def _place(self, tree):
+        """Stage a params tree onto this runner's pinned device (replica
+        pinning via ``device=``); unpinned runners let jit place it."""
+        if self.device is None:
+            return tree
+        return jax.device_put(tree, self.device)
+
+    def _slot(self, model_id: str) -> _ModelSlot:
+        s = self._slots.get(model_id)
+        if s is not None:
+            return s
+        with self._slots_lock:
+            s = self._slots.get(model_id)
+            if s is not None:
+                return s
+            e = self.registry.entry(model_id)
+            live = self.registry.live(model_id)
+            cfg = e.cfg
+            if (
+                model_id == self.default_model
+                and self._num_classes_override is not None
+            ):
+                n_cls = self._num_classes_override
+            else:
+                n_cls = cfg.dataset.NUM_CLASSES
+            post = None
+            use_post = (
+                cfg.TEST.DEVICE_POSTPROCESS
+                if self._device_postprocess is None
+                else self._device_postprocess
             )
-        # deterministic: shape-independent reduction order on CPU, making
-        # cross-bucket detections bitwise identical (Predictor docstring);
-        # default fast mode agrees to ~1e-5 px on box coordinates
-        self.predictor = Predictor(model, params, postprocess=post,
-                                   donate=donate, deterministic=deterministic)
+            if use_post and not cfg.network.USE_MASK:
+                from mx_rcnn_tpu.ops.postprocess import make_test_postprocess
+
+                post = make_test_postprocess(
+                    cfg, n_cls, cfg.TEST.SCORE_THRESH,
+                    max_out=cfg.TEST.DET_PER_CLASS,
+                )
+            # deterministic: shape-independent reduction order on CPU,
+            # making cross-bucket detections bitwise identical (Predictor
+            # docstring); fast mode agrees to ~1e-5 px on box coordinates
+            predictor = Predictor(
+                e.model, self._place(live.params), postprocess=post,
+                donate=self._donate, deterministic=self._deterministic,
+            )
+            s = _ModelSlot(
+                model_id, predictor, live.version, cfg, n_cls,
+                bool(cfg.TEST.UINT8_TRANSFER),
+            )
+            self._slots[model_id] = s
+            return s
+
+    def _sync(self, slot: _ModelSlot) -> None:
+        """Apply a committed (or rolled-back) version flip: pointer-swap
+        the slot predictor's params to the registry's live version.
+        Same structure/shape/dtype tree → the compiled executable is
+        reused, so the swap costs one pointer write between batches."""
+        live = self.registry.live(slot.model_id)
+        if live.version == slot.version:
+            return
+        with slot.lock:
+            live = self.registry.live(slot.model_id)
+            if live.version == slot.version:
+                return
+            staged = self._staged.pop((slot.model_id, live.version), None)
+            # any other staged tree for this model is a candidate that
+            # lost (rolled back / cancelled): drop its buffers now
+            for k in [k for k in self._staged if k[0] == slot.model_id]:
+                self._staged.pop(k, None)
+            slot.predictor.params = (
+                staged if staged is not None else self._place(live.params)
+            )
+            slot.version = live.version
+            self.swaps_applied += 1
+
+    @property
+    def predictor(self) -> Predictor:
+        """The default model's predictor (legacy single-model surface)."""
+        return self._slot(self.default_model).predictor
 
     # ---- request/batch plumbing
     def make_request(
-        self, im: np.ndarray, deadline: Optional[float] = None
+        self,
+        im: np.ndarray,
+        deadline: Optional[float] = None,
+        model: Optional[str] = None,
     ) -> Request:
-        return prepare_request(im, self.cfg, self.ladder, deadline)
+        if model is None:
+            return prepare_request(im, self.cfg, self.ladder, deadline)
+        return prepare_request(
+            im, self.registry.entry(model).cfg, self.ladder, deadline,
+            model=model,
+        )
 
     def assemble(self, requests: List[Request]) -> Dict[str, np.ndarray]:
-        """Bucket-homogeneous requests → device batch padded to
+        """(model, bucket)-homogeneous requests → device batch padded to
         ``max_batch`` (pad slots replicate slot 0 so every bucket keeps a
         single jit signature and pad work is never a fresh codepath)."""
         n = len(requests)
@@ -227,8 +371,14 @@ class ServeRunner:
         bh, bw = requests[0].bucket
         if any(r.bucket != (bh, bw) for r in requests):
             raise ValueError("mixed buckets in one batch")
+        mid = requests[0].model
+        if any(r.model != mid for r in requests):
+            raise ValueError("mixed models in one batch")
+        uint8 = self._slot(
+            self.default_model if mid is None else mid
+        ).uint8
         images = np.zeros(
-            (self.max_batch, bh, bw, 3), np.uint8 if self.uint8 else np.float32
+            (self.max_batch, bh, bw, 3), np.uint8 if uint8 else np.float32
         )
         im_info = np.zeros((self.max_batch, 3), np.float32)
         orig_hw = np.zeros((self.max_batch, 2), np.float32)
@@ -242,17 +392,25 @@ class ServeRunner:
             orig_hw[i] = orig_hw[0]
         return {"images": images, "im_info": im_info, "orig_hw": orig_hw}
 
-    def _signature(self, batch: Dict[str, np.ndarray]) -> Tuple:
-        return (batch["images"].shape, str(batch["images"].dtype))
+    def _signature(
+        self, batch: Dict[str, np.ndarray], model: Optional[str] = None
+    ) -> Tuple:
+        return (
+            self.default_model if model is None else model,
+            batch["images"].shape,
+            str(batch["images"].dtype),
+        )
 
-    def stage(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    def stage(
+        self, batch: Dict[str, np.ndarray], model: Optional[str] = None
+    ) -> Dict[str, np.ndarray]:
         """Host batch → device batch in the compiled forward's input
         layouts (captured at :meth:`warmup`), so the transfer lands
         device-native and XLA inserts no relayout copy on dispatch.
         Falls back to a plain ``device_put`` for signatures without a
         captured layout."""
         self.staged_batches += 1
-        layouts = self._layouts.get(self._signature(batch))
+        layouts = self._layouts.get(self._signature(batch, model))
         if layouts is not None:
             try:
                 out = jax.device_put(batch, layouts)
@@ -262,37 +420,134 @@ class ServeRunner:
                 pass
         return jax.device_put(batch)
 
-    def run(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        """Blocking forward; accounts the jit signature.  Blocking by
+    def run(
+        self,
+        batch: Dict[str, np.ndarray],
+        model: Optional[str] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Blocking forward through ``model``'s slot (default model when
+        None); syncs the slot to the registry's live version first and
+        accounts the (model, shape, dtype) jit signature.  Blocking by
         design: the engine overlaps batches with threads, which the
         relay-attached TPU actually pipelines (see ``pipelined``)."""
-        self.compile_cache.record(self._signature(batch))
+        mid = self.default_model if model is None else model
+        slot = self._slot(mid)
+        self._sync(slot)
+        self.compile_cache.record(self._signature(batch, mid))
         if self.layout_feed:
-            batch = self.stage(batch)
-        return self.predictor.predict(batch)
+            batch = self.stage(batch, mid)
+        out = slot.predictor.predict(batch)
+        self.served_buckets.setdefault(mid, set()).add(
+            tuple(batch["images"].shape[1:3])
+        )
+        return out
 
-    def warmup(self) -> int:
-        """Precompile every ladder bucket at the (single) serving batch
-        size; returns the number of signatures compiled.  After this,
-        ``compile_cache.misses`` must not grow.  With ``layout_feed``,
-        also captures each bucket's compiled input layouts for
-        :meth:`stage`."""
-        for bh, bw in self.ladder:
-            req = Request(
-                image=np.zeros(
-                    (bh, bw, 3), np.uint8 if self.uint8 else np.float32
-                ),
-                im_info=np.array([bh, bw, 1.0], np.float32),
-                orig_hw=(bh, bw),
-                bucket=(bh, bw),
-            )
-            batch = self.assemble([req])
-            self.run(batch)
-            if self.layout_feed:
-                layouts = self.predictor.input_layouts(batch)
-                if layouts is not None:
-                    self._layouts[self._signature(batch)] = layouts
+    def _probe_request(self, model_id: str, bucket: Tuple[int, int]) -> Request:
+        bh, bw = bucket
+        uint8 = self._slot(model_id).uint8
+        return Request(
+            image=np.zeros((bh, bw, 3), np.uint8 if uint8 else np.float32),
+            im_info=np.array([bh, bw, 1.0], np.float32),
+            orig_hw=(bh, bw),
+            bucket=(bh, bw),
+            model=None if model_id == self.default_model else model_id,
+        )
+
+    def warmup(self, buckets=None, models=None) -> int:
+        """Precompile serving signatures; returns total compile misses.
+
+        Default: every registered model × every ladder rung (the cold
+        start).  ``buckets`` partitions the warm set (ISSUE 7 satellite):
+        a dict ``{model: iterable-of-(H, W)}`` warms exactly those rungs
+        (a recovering replica passes the buckets it actually served —
+        models/rungs it never saw are warmed lazily on first dispatch);
+        a plain iterable applies to ``models`` (default model only when
+        unset).  After warmup, ``compile_cache.misses`` must not grow.
+        With ``layout_feed``, also captures each signature's compiled
+        input layouts for :meth:`stage`."""
+        if isinstance(buckets, dict):
+            per = {m: sorted(bs) for m, bs in buckets.items() if bs}
+            if not per:  # empty partition: fall back to the full cold start
+                per = {m: list(self.ladder)
+                       for m in self.registry.model_ids()}
+        elif buckets is not None:
+            per = {
+                m: sorted(buckets)
+                for m in (models if models else [self.default_model])
+            }
+        else:
+            per = {
+                m: list(self.ladder)
+                for m in (models if models else self.registry.model_ids())
+            }
+        for mid, rungs in per.items():
+            slot = self._slot(mid)
+            self._sync(slot)
+            for bucket in rungs:
+                batch = self.assemble(
+                    [self._probe_request(mid, tuple(bucket))]
+                )
+                self.run(batch, model=mid)
+                if self.layout_feed:
+                    layouts = slot.predictor.input_layouts(batch)
+                    if layouts is not None:
+                        self._layouts[self._signature(batch, mid)] = layouts
         return self.compile_cache.misses
+
+    # ---- hot-swap (SwapController target surface)
+    def warm_version(
+        self,
+        model: Optional[str],
+        version: int,
+        params,
+        buckets=None,
+        abort=None,
+    ) -> int:
+        """Drive CANDIDATE params through this runner's served
+        signatures for ``model``, off the live path
+        (:meth:`Predictor.predict_with` — params are a jit argument, so
+        the compiled executables are reused: zero new compile misses).
+        The device-placed tree is staged under ``(model, version)`` for
+        :meth:`_sync` to adopt at commit.  ``abort`` (the controller's
+        cancel hook) is called before the device placement and between
+        rungs — a cancelled swap raises there, before any further
+        device work.  Returns the number of rungs warmed."""
+        mid = self.default_model if model is None else model
+        slot = self._slot(mid)
+        if abort is not None:
+            abort()
+        placed = self._place(params)
+        if buckets is None:
+            buckets = sorted(self.served_buckets.get(mid, ())) or list(
+                self.ladder
+            )
+        warmed = 0
+        for bucket in buckets:
+            if abort is not None:
+                abort()
+            batch = self.assemble([self._probe_request(mid, tuple(bucket))])
+            slot.predictor.predict_with(placed, batch)
+            warmed += 1
+        self._staged[(mid, int(version))] = placed
+        return warmed
+
+    def canary(self, model: Optional[str] = None) -> int:
+        """One probe batch through the LIVE path (smallest served rung):
+        forces :meth:`_sync` onto the just-committed version and proves
+        the swapped predictor actually serves.  Raising here is the
+        rollback trigger."""
+        mid = self.default_model if model is None else model
+        served = sorted(self.served_buckets.get(mid, ()))
+        bucket = served[0] if served else next(iter(self.ladder))
+        batch = self.assemble([self._probe_request(mid, bucket)])
+        self.run(batch, model=mid)
+        return 1
+
+    def discard_version(self, model: Optional[str], version: int) -> None:
+        """Drop a losing candidate's staged device tree (rollback or
+        cancel cleanup)."""
+        mid = self.default_model if model is None else model
+        self._staged.pop((mid, int(version)), None)
 
     # ---- per-image postprocess
     def detections_for(
@@ -302,14 +557,16 @@ class ServeRunner:
         index: int,
         orig_hw: Optional[Tuple[float, float]] = None,
         thresh: Optional[float] = None,
+        model: Optional[str] = None,
     ) -> ClsDets:
+        slot = self._slot(self.default_model if model is None else model)
         if orig_hw is None:
             orig_hw = tuple(batch["orig_hw"][index])
         cls_dets, _ = detections_from_output(
-            out, batch["im_info"][index], orig_hw, self.cfg,
-            self.num_classes, index=index, thresh=thresh,
+            out, batch["im_info"][index], orig_hw, slot.cfg,
+            slot.num_classes, index=index, thresh=thresh,
         )
-        cls_dets, _ = cap_detections(cls_dets, self.cfg.TEST.MAX_PER_IMAGE)
+        cls_dets, _ = cap_detections(cls_dets, slot.cfg.TEST.MAX_PER_IMAGE)
         return cls_dets
 
     # ---- synchronous single image (demo path)

@@ -13,6 +13,11 @@ Examples:
   # real checkpoint at the flagship config
   python -m mx_rcnn_tpu.tools.serve --network resnet --params final.pkl \
       --requests 256 --concurrency 16 --out serve_report.json
+
+  # multi-tenant: a second family through the same batcher, plus a
+  # mid-load hot-swap of it (the ``swap <model> <ckpt>`` admin command)
+  python -m mx_rcnn_tpu.tools.serve --small \
+      --model tenant=vgg:random:1 --swap tenant=ckpts/epoch_0002
 """
 
 from __future__ import annotations
@@ -21,6 +26,8 @@ import argparse
 import dataclasses
 import json
 import logging
+import threading
+import time
 
 import jax
 import numpy as np
@@ -29,6 +36,7 @@ from mx_rcnn_tpu.config import generate_config
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.serve.engine import ServingEngine
 from mx_rcnn_tpu.serve.loadgen import DEFAULT_SIZES, run_load
+from mx_rcnn_tpu.serve.registry import DEFAULT_MODEL, ModelRegistry
 from mx_rcnn_tpu.serve.runner import ServeRunner
 
 logger = logging.getLogger(__name__)
@@ -59,6 +67,49 @@ def small_config(network: str):
     )
 
 
+def random_params(model, cfg, seed: int = 0):
+    """Random-init params at the config's first bucket (the no-checkpoint
+    path — latency numbers stay valid; detections are noise)."""
+    h, w = cfg.SHAPE_BUCKETS[0]
+    return model.init(
+        {"params": jax.random.key(seed)},
+        np.zeros((1, h, w, 3), np.float32),
+        np.array([[h, w, 1.0]], np.float32),
+        train=False,
+    )["params"]
+
+
+def load_model_source(src: str, default_network: str, small: bool,
+                      dataset: str):
+    """``--model NAME=SPEC`` source → (model, cfg, params, digest).
+
+    SPEC is ``[network:]source`` with source either a committed
+    checkpoint directory (manifest-verified before registering) or
+    ``random[:seed]``; the network defaults to ``--network``.
+    """
+    from mx_rcnn_tpu.config import NETWORKS
+
+    network, source = default_network, src
+    head, _, rest = src.partition(":")
+    if rest and head in NETWORKS:
+        network, source = head, rest
+    cfg = small_config(network) if small else generate_config(
+        network, dataset
+    )
+    model = build_model(cfg)
+    if source.startswith("random"):
+        _, _, seed_s = source.partition(":")
+        params = random_params(model, cfg, int(seed_s) if seed_s else 0)
+        return model, cfg, params, None
+    from mx_rcnn_tpu.core.checkpoint import restore_tree, verify_manifest
+
+    man = verify_manifest(source)  # the register-time trust gate
+    tree = restore_tree(source)
+    params = tree["params"] if isinstance(tree, dict) and "params" in tree \
+        else tree
+    return model, cfg, params, man.get("checksum")
+
+
 def main():
     from mx_rcnn_tpu.utils.platform import cli_bootstrap
 
@@ -86,6 +137,15 @@ def main():
     p.add_argument("--concurrency", type=int, default=8)
     p.add_argument("--deadline_ms", type=float, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--model", action="append", default=[],
+                   metavar="NAME=[network:]SRC",
+                   help="register an extra model family (repeatable); SRC "
+                   "is a committed checkpoint dir or random[:seed].  Load "
+                   "is then mixed across the default and every named "
+                   "family through the one shared batcher")
+    p.add_argument("--swap", default=None, metavar="MODEL=CKPT_DIR",
+                   help="hot-swap MODEL to the checkpoint mid-load (the "
+                   "'swap <model> <ckpt>' admin command, exercised live)")
     p.add_argument("--out", default=None, help="write the report JSON here")
     args = p.parse_args()
 
@@ -101,27 +161,41 @@ def main():
 
         params = load_params(args.params)
     else:
-        h, w = cfg.SHAPE_BUCKETS[0]
-        params = model.init(
-            {"params": jax.random.key(0)},
-            np.zeros((1, h, w, 3), np.float32),
-            np.array([[h, w, 1.0]], np.float32),
-            train=False,
-        )["params"]
+        params = random_params(model, cfg, 0)
         logger.warning("no --params — serving a random-init model")
+
+    # every family — the default plus each --model — lives in ONE
+    # registry; the engine resolves (model, version) per batch, so adding
+    # a tenant changes request schemas, not the serving stack
+    registry = ModelRegistry()
+    registry.register(DEFAULT_MODEL, model, cfg, params)
+    load_models = None
+    if args.model:
+        load_models = [None]
+        for spec in args.model:
+            name, _, src = spec.partition("=")
+            if not src:
+                p.error(f"--model needs NAME=SRC, got {spec!r}")
+            t_model, t_cfg, t_params, digest = load_model_source(
+                src, args.network, args.small, args.dataset
+            )
+            registry.register(name, t_model, t_cfg, t_params, digest=digest,
+                              source=src)
+            load_models.append(name)
+            logger.info("registered model %r from %s", name, src)
 
     if args.replicas > 1 or args.force_pool:
         from mx_rcnn_tpu.serve.router import ReplicaPool, make_replica_factory
 
         factory = make_replica_factory(
-            lambda params: ServeRunner(
-                model, params, cfg, max_batch=args.max_batch
+            lambda registry, device: ServeRunner(
+                registry=registry, device=device, max_batch=args.max_batch
             ),
-            params,
+            registry=registry,
         )
         runner = ReplicaPool(factory, n_replicas=args.replicas)
     else:
-        runner = ServeRunner(model, params, cfg, max_batch=args.max_batch)
+        runner = ServeRunner(registry=registry, max_batch=args.max_batch)
     engine = ServingEngine(
         runner,
         max_linger=args.linger_ms / 1000.0,
@@ -129,10 +203,31 @@ def main():
         in_flight=args.in_flight,
     )
     logger.info(
-        "warming up %d bucket(s) x %d replica(s)...",
-        len(runner.ladder), args.replicas,
+        "warming up %d bucket(s) x %d model(s) x %d replica(s)...",
+        len(runner.ladder), len(registry.model_ids()), args.replicas,
     )
+    swap_result = {}
+
+    def run_swap():
+        # fire once the load is genuinely mid-flight, then block through
+        # the admin surface so the report carries the full result
+        smodel, _, sckpt = args.swap.partition("=")
+        t_end = time.monotonic() + 120.0
+        while (engine.metrics.completed < max(1, args.requests // 3)
+               and time.monotonic() < t_end):
+            time.sleep(0.005)
+        t0 = time.monotonic()
+        try:
+            out = engine.admin(f"swap {smodel} {sckpt}")
+            swap_result.update(out, wall_s=round(time.monotonic() - t0, 4))
+        except Exception as e:  # noqa: BLE001 — report it, don't kill the load
+            swap_result.update(error=repr(e))
+
     with engine:
+        swapper = None
+        if args.swap:
+            swapper = threading.Thread(target=run_swap, name="admin-swap")
+            swapper.start()
         report = run_load(
             engine,
             num_requests=args.requests,
@@ -143,7 +238,11 @@ def main():
                 args.deadline_ms / 1000.0
                 if args.deadline_ms is not None else None
             ),
+            models=load_models,
         )
+        if swapper is not None:
+            swapper.join()
+            report["swap"] = swap_result
     if hasattr(runner, "close"):
         runner.close()
     print(json.dumps(report, indent=1))

@@ -43,10 +43,25 @@ attempts within a dispatch share the ordinal, so ``xN`` spans attempts).
                              in-flight batch is requeued, and it
                              rewarms/rejoins once the wedge releases)
 
+Swap-phase injectors (ISSUE 7) are keyed by the registry-wide swap
+ordinal (1-based: the Nth ``SwapController`` the registry launches, any
+model), or ``*`` for every swap.  Each fires once per swap at its
+pipeline stage and raises :class:`InjectedSwapFault`, driving the
+controller's rollback path::
+
+    swap_verify_fail@N       fail swap N's manifest-verification stage
+                             (the candidate never reaches the device)
+    swap_warm_fail@N         fail swap N after its warmup rungs ran
+                             (staged device buffers must be discarded)
+    canary_fail@N            fail swap N's post-commit canary probe —
+                             the committed version must roll back to
+                             the previous LIVE between batches
+
 Example::
 
     MX_RCNN_FAULTS="nan_loss@5,record_fail@3,save_crash@2,stall@7:30"
     MX_RCNN_FAULTS="predict_fail@0.2x1,replica_wedge@1.0:3,predict_stall@2.*x4:0.4"
+    MX_RCNN_FAULTS="swap_verify_fail@1,canary_fail@2"
 
 Injection sites are no-ops (one env lookup) when the variable is unset,
 so production paths pay nothing.
@@ -78,8 +93,21 @@ class InjectedPredictFault(RuntimeError):
     a device/relay fault."""
 
 
+class InjectedSwapFault(RuntimeError):
+    """Raised by the swap-phase injector inside a SwapController stage —
+    a RuntimeError, so the controller's rollback handling treats it
+    exactly like a real verification/warmup/canary failure."""
+
+
 # serve-phase kinds take the compound REPLICA.ORDINAL key
 _SERVE_KINDS = ("predict_fail", "predict_stall", "replica_wedge")
+
+# swap-phase kinds, keyed by the 1-based registry-wide swap ordinal
+_SWAP_KINDS = {
+    "verify": "swap_verify_fail",
+    "warm": "swap_warm_fail",
+    "canary": "canary_fail",
+}
 
 
 @dataclass
@@ -108,10 +136,13 @@ _registry: Optional[_Registry] = None
 
 
 def _parse_key(s: str):
-    """``R.B`` / ``R.*`` → (replica, ordinal|None); plain int otherwise."""
+    """``R.B`` / ``R.*`` → (replica, ordinal|None); bare ``*`` → None
+    (match-any, the swap kinds); plain int otherwise."""
     if "." in s:
         r, _, o = s.partition(".")
         return (int(r), None if o == "*" else int(o))
+    if s == "*":
+        return None
     return int(s)
 
 
@@ -236,3 +267,24 @@ def predict_fault(replica: int, ordinal: int) -> None:
             )
         time.sleep(f.arg)
         return
+
+
+def swap_fault(stage: str, ordinal: int) -> None:
+    """SwapController hook (``serve/registry.py``): fail this swap's
+    ``stage`` ("verify" | "warm" | "canary").  Called once per swap per
+    stage with the registry-wide 1-based swap ordinal; a matching
+    un-exhausted fault raises :class:`InjectedSwapFault`, which the
+    controller handles exactly like a real gate failure (rollback)."""
+    reg = _active()
+    if reg is None:
+        return
+    kind = _SWAP_KINDS[stage]
+    for f in reg.faults:
+        if f.kind != kind:
+            continue
+        if f.key is not None and f.key != ordinal:
+            continue
+        if f.fire():
+            raise InjectedSwapFault(
+                f"injected {kind}: swap #{ordinal} ({stage} stage)"
+            )
