@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast resilience bench bench-eval eval-bench serve serve-fault swap pipeline integration-gate clean-native
+.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-fault swap pipeline integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -38,6 +38,15 @@ test-kernels:
 # never unrepresented in the fast tier
 test-fast:
 	$(PY) -m pytest tests/ -m "not slow" -q
+
+# graftlint: project-native static analysis (ANALYSIS.md) — exits
+# nonzero on any unsuppressed finding, stale baseline entry, or
+# unparseable BENCH_*.json artifact.  Pure stdlib-ast: no jax import.
+lint:
+	$(PY) tools/lint.py
+
+# the CI gate: static analysis first (seconds), then the fast tier
+check: lint test-fast
 	$(PY) -m pytest "tests/test_parallel.py::test_mesh_shapes" \
 	      "tests/test_parallel.py::test_dp_grads_match_single_device" -q
 

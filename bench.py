@@ -894,7 +894,7 @@ def bench_pipeline(
     import jax
 
     from mx_rcnn_tpu.core.pipeline import DeviceFeed, PipelinedLoop
-    from mx_rcnn_tpu.core.resilience import GuardedLoop
+    from mx_rcnn_tpu.core.resilience import GuardedLoop, host_copy
     from mx_rcnn_tpu.core.train import (
         create_train_state,
         make_optimizer,
@@ -924,7 +924,9 @@ def bench_pipeline(
     # it reassociates reductions across threads, so even the sync
     # baseline is not repeatable against itself (~1e-7/run drift)
     step_fn = make_train_step(model, tx, donate=True, deterministic=True)
-    host_params = jax.device_get(params)
+    # owning copy, not a device_get view: both runs re-place from
+    # host_params while the donating step recycles device buffers
+    host_params = host_copy(params)
 
     def batch_stream(n):
         loader = TrainLoader(

@@ -1,0 +1,15 @@
+"""graftlint — project-native static analysis for the mx_rcnn_tpu stack.
+
+Rules distilled from real incidents (see ANALYSIS.md):
+
+* R1 host-copy escape      (rules_hostcopy)  — PR 4 zero-copy device_get views
+* R2 use-after-donate      (rules_hostcopy)  — PR 4 donation discipline
+* R3 jit purity            (rules_jit)       — recompile / trace hazards
+* R4 lock order + device-under-lock (rules_locks) — serve-stack deadlocks
+* R5 exactly-once resolution (rules_futures) — PR 6 requeue-never-drop
+* R6 fault-hook coverage   (rules_faults)    — MX_RCNN_FAULTS drift
+
+``lockcheck`` is the runtime counterpart of R4 (MX_RCNN_LOCK_CHECK=1) and
+is imported by the serve stack at construction time, so this package
+must stay stdlib-only and cheap to import.
+"""

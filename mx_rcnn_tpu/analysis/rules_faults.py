@@ -1,0 +1,155 @@
+"""R6 fault-hook coverage — MX_RCNN_FAULTS must not drift.
+
+The fault-injection surface (``utils/faults.py``) is only as good as
+its wiring: a hook nobody calls is dead coverage (the fault matrix
+believes a path is exercised when it is not), and a call to a
+misspelled hook raises AttributeError only when that injector fires.
+This rule cross-references, at lint time:
+
+* every public hook in faults.py (a module-level function that consults
+  ``_active()``) is called from at least one non-test module;
+* every ``faults.<name>(...)`` call in the tree resolves to a real
+  module-level function in faults.py;
+* the ``_KNOWN_KINDS`` whitelist (which makes spec typos a hard parse
+  error) exactly matches the set of kind strings the hooks actually
+  consult — adding a kind to a hook without whitelisting it (or vice
+  versa) fails the lint run.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from mx_rcnn_tpu.analysis.engine import Finding, Module, Rule, dotted
+
+
+class FaultCoverage(Rule):
+    id = "R6"
+    name = "fault-hook coverage"
+
+    FAULTS_SUFFIX = "utils/faults.py"
+
+    def finalize(self, modules: Sequence[Module]) -> List[Finding]:
+        faults_mod = next(
+            (m for m in modules if m.path.endswith(self.FAULTS_SUFFIX)), None
+        )
+        if faults_mod is None:
+            return []
+        out: List[Finding] = []
+
+        hooks: Dict[str, int] = {}
+        funcs: Set[str] = set()
+        collections: Dict[str, Set[str]] = {}
+        known_kinds: Optional[Set[str]] = None
+        known_kinds_line = 0
+
+        for node in faults_mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    strings = {
+                        n.value
+                        for n in ast.walk(node.value)
+                        if isinstance(n, ast.Constant)
+                        and isinstance(n.value, str)
+                    }
+                    if t.id == "_KNOWN_KINDS":
+                        # literal strings plus any referenced collection
+                        # (e.g. ``| set(_SERVE_KINDS)``) gathered above
+                        for n in ast.walk(node.value):
+                            if (
+                                isinstance(n, ast.Name)
+                                and n.id in collections
+                            ):
+                                strings |= collections[n.id]
+                        known_kinds = strings
+                        known_kinds_line = node.lineno
+                    elif strings:
+                        collections[t.id] = strings
+            if isinstance(node, ast.FunctionDef):
+                funcs.add(node.name)
+                if any(
+                    isinstance(n, ast.Call) and dotted(n.func) == "_active"
+                    for n in ast.walk(node)
+                ):
+                    hooks[node.name] = node.lineno
+
+        # kinds each hook consults: literal comparisons + collections used
+        consulted: Set[str] = set()
+        for name in hooks:
+            fn = next(
+                n
+                for n in faults_mod.tree.body
+                if isinstance(n, ast.FunctionDef) and n.name == name
+            )
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Compare):
+                    for side in [n.left] + list(n.comparators):
+                        if isinstance(side, ast.Constant) and isinstance(
+                            side.value, str
+                        ):
+                            d = dotted(n.left)
+                            if (d or "").endswith("kind") or any(
+                                (dotted(c) or "").endswith("kind")
+                                for c in n.comparators
+                            ):
+                                consulted.add(side.value)
+                if isinstance(n, ast.Name) and n.id in collections:
+                    consulted.update(collections[n.id])
+
+        if known_kinds is not None and consulted and known_kinds != consulted:
+            missing = sorted(consulted - known_kinds)
+            extra = sorted(known_kinds - consulted)
+            parts = []
+            if missing:
+                parts.append(f"hooks consult unlisted kind(s) {missing}")
+            if extra:
+                parts.append(f"whitelisted kind(s) {extra} never consulted")
+            out.append(
+                Finding(
+                    self.id,
+                    faults_mod.path,
+                    known_kinds_line,
+                    "<module>",
+                    "_KNOWN_KINDS drift: " + "; ".join(parts),
+                )
+            )
+
+        # cross-module call census
+        called: Set[str] = set()
+        for m in modules:
+            if m is faults_mod:
+                continue
+            for n in ast.walk(m.tree):
+                if isinstance(n, ast.Call):
+                    d = dotted(n.func) or ""
+                    if d.startswith("faults."):
+                        name = d.split(".", 1)[1]
+                        called.add(name)
+                        if name not in funcs:
+                            out.append(
+                                Finding(
+                                    self.id,
+                                    m.path,
+                                    n.lineno,
+                                    m.scope_of(n),
+                                    f"call to nonexistent fault hook "
+                                    f"`faults.{name}` — would raise "
+                                    f"AttributeError when reached",
+                                )
+                            )
+
+        for name, line in sorted(hooks.items()):
+            if name not in called:
+                out.append(
+                    Finding(
+                        self.id,
+                        faults_mod.path,
+                        line,
+                        name,
+                        f"fault hook `{name}` is never called from any "
+                        f"non-test module — its injectors can never fire",
+                    )
+                )
+        return out

@@ -1,0 +1,152 @@
+"""R5 exactly-once resolution — the PR 6 requeue-never-drop rule.
+
+Anything popped from a dispatch queue carries a caller-visible future;
+the holder must resolve it, requeue it, or hand it to someone who will,
+on EVERY exit path.  The incident class this catches is the early
+``return``/``continue`` that silently drops a dispatch, wedging the
+caller until its deadline.
+
+Detection: a *take* is a name bound from ``<recv>.get(...)``,
+``<recv>.get_nowait()``, ``<recv>.popleft()`` or
+``<recv>.next_batch(...)`` where the receiver name looks like a
+dispatch queue (``inbox``/``queue``/``batcher``/``pending``).  From the
+take, every control-flow path to a scope exit (or to falling off the
+end of the enclosing loop body, which re-takes) must REFERENCE the
+bound name at least once — resolving, requeuing, forwarding, and the
+``if d is None: break`` sentinel check all count.  A path that exits
+without ever looking at the value cannot possibly have resolved it.
+
+This is deliberately an under-approximation (a path could look at the
+value and still drop it); the fault-matrix tests own the stronger
+guarantee.  It is also zero-noise by construction on code that checks
+its takes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from mx_rcnn_tpu.analysis.engine import Finding, Module, Rule, dotted
+
+TAKE_METHODS = {"get", "get_nowait", "popleft", "next_batch"}
+QUEUEISH = re.compile(r"(inbox|queue|batcher|pending)", re.IGNORECASE)
+
+
+def _uses_name(node: ast.AST, name: str) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id == name for n in ast.walk(node)
+    )
+
+
+class ExactlyOnce(Rule):
+    id = "R5"
+    name = "exactly-once resolution"
+
+    def _in_scope(self, module: Module) -> bool:
+        return "/serve/" in f"/{module.path}"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        if not self._in_scope(module):
+            return []
+        out: List[Finding] = []
+        for n in ast.walk(module.tree):
+            if not (
+                isinstance(n, ast.Assign)
+                and isinstance(n.value, ast.Call)
+                and isinstance(n.value.func, ast.Attribute)
+                and n.value.func.attr in TAKE_METHODS
+            ):
+                continue
+            recv = dotted(n.value.func.value) or ""
+            if not QUEUEISH.search(recv):
+                continue
+            if len(n.targets) != 1 or not isinstance(n.targets[0], ast.Name):
+                continue
+            name = n.targets[0].id
+            if not self._covered(module, n, name):
+                out.append(
+                    Finding(
+                        self.id,
+                        module.path,
+                        n.lineno,
+                        module.scope_of(n),
+                        f"`{name}` taken from `{recv}.{n.value.func.attr}` "
+                        f"can reach a scope exit without being resolved, "
+                        f"requeued, or forwarded",
+                    )
+                )
+        return out
+
+    # ---- path coverage ----------------------------------------------
+
+    def _covered(self, module: Module, take: ast.stmt, name: str) -> bool:
+        cont = self._continuation(module, take)
+        return self._paths_touch(cont, name)
+
+    def _continuation(self, module: Module, stmt: ast.stmt) -> List[ast.stmt]:
+        """Statements that execute after ``stmt``: following siblings at
+        each enclosing block level, up to the enclosing function.  The
+        loop back-edge (falling off a loop body re-takes) is treated as
+        a safe exit by truncating at the loop."""
+        out: List[ast.stmt] = []
+        node: ast.AST = stmt
+        while True:
+            parent = module.parent(node)
+            if parent is None or isinstance(
+                parent, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+            ):
+                out.extend(self._siblings_after(parent, node))
+                return out
+            out.extend(self._siblings_after(parent, node))
+            if isinstance(parent, (ast.For, ast.While)):
+                return out  # back-edge: next iteration re-takes
+            node = parent
+
+    def _siblings_after(
+        self, parent: Optional[ast.AST], node: ast.AST
+    ) -> List[ast.stmt]:
+        if parent is None:
+            return []
+        out: List[ast.stmt] = []
+        for field in ("body", "orelse", "finalbody"):
+            blk = getattr(parent, field, None)
+            if isinstance(blk, list) and node in blk:
+                out.extend(blk[blk.index(node) + 1:])
+        if isinstance(parent, ast.Try):
+            for h in parent.handlers:
+                if node in h.body:
+                    out.extend(h.body[h.body.index(node) + 1:])
+                    out.extend(parent.finalbody)
+        if isinstance(parent, ast.ExceptHandler):
+            if node in parent.body:
+                out.extend(parent.body[parent.body.index(node) + 1:])
+        return out
+
+    def _paths_touch(self, stmts: List[ast.stmt], name: str) -> bool:
+        """True when every path through ``stmts`` references ``name``
+        before exiting the scope."""
+        for i, s in enumerate(stmts):
+            rest = stmts[i + 1:]
+            if _uses_name(s, name):
+                return True  # this path has looked at the take
+            if isinstance(s, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+                return False  # exit without ever touching it
+            if isinstance(s, ast.If):
+                return self._paths_touch(s.body + rest, name) and (
+                    self._paths_touch(s.orelse + rest, name)
+                )
+            if isinstance(s, ast.Try):
+                ok = self._paths_touch(s.body + s.orelse + s.finalbody + rest, name)
+                for h in s.handlers:
+                    ok = ok and self._paths_touch(
+                        h.body + s.finalbody + rest, name
+                    )
+                return ok
+            if isinstance(s, ast.With):
+                return self._paths_touch(s.body + rest, name)
+            if isinstance(s, (ast.For, ast.While)):
+                # zero-iteration possibility: coverage must come later
+                continue
+        return False  # fell off the end of the scope without touching

@@ -1,0 +1,355 @@
+"""R1 host-copy escape and R2 use-after-donate.
+
+Both rules encode the PR 4 donation incident: on CPU,
+``jax.device_get`` returns ZERO-COPY numpy views of device buffers, so
+any view that outlives the device value it aliases (returned, yielded,
+stored on an object, or captured by a closure) reads garbage the moment
+a donating step reuses that buffer.  ``resilience.host_copy`` (=
+``tree_map(np.array, device_get(tree))``) is the owning-copy idiom.
+
+R1 flags device_get results that ESCAPE the expression that produced
+them.  Immediate consumption (passed straight into another call,
+reduced to a python scalar, ``.tobytes()``-style copying methods) is
+not an escape.  The walk deliberately errs silent on constructs it
+cannot classify — the analyzer must be zero-noise on a clean tree.
+
+R2 tracks callables built with live donation in the SAME scope —
+``jax.jit(f, donate_argnums=...)``, ``jax.pmap(...)``, and the project
+factories ``make_train_step(..., donate=True)`` /
+``make_parallel_train_step(..., donate=True)`` (donated position 0) —
+and flags any read of a bare-name argument passed in a donated position
+after the donating call, unless the name was rebound first
+(``state = step(state, ...)`` is the safe pattern).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from mx_rcnn_tpu.analysis.engine import Finding, Module, Rule, dotted
+
+DEVICE_GET = {"jax.device_get", "device_get"}
+# calls that take ownership / produce a fresh host object
+SAFE_CALLS = {
+    "host_copy",
+    "resilience.host_copy",
+    "np.array",
+    "numpy.array",
+    "onp.array",
+    "float",
+    "int",
+    "bool",
+    "str",
+    "len",
+}
+# view-preserving wrappers the walk sees through
+PASSTHROUGH_CALLS = {
+    "dict",
+    "list",
+    "tuple",
+    "sorted",
+    "np.asarray",
+    "numpy.asarray",
+    "jax.tree_util.tree_leaves",
+    "tree_leaves",
+}
+TREE_MAP = {"jax.tree_util.tree_map", "tree_map", "jax.tree.map"}
+COPYING_FNS = {"np.array", "numpy.array", "onp.array"}
+# methods on an array that return a fresh host object
+COPY_METHODS = {"tobytes", "copy", "astype", "item", "tolist", "sum", "mean"}
+
+
+def _is_device_get(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call) and (dotted(node.func) or "") in DEVICE_GET
+    )
+
+
+class HostCopyEscape(Rule):
+    id = "R1"
+    name = "host-copy escape"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if _is_device_get(node):
+                f = self._classify(module, node)
+                if f is not None:
+                    out.append(f)
+        return out
+
+    # ---- escape classification -------------------------------------
+
+    def _walk_up(
+        self, module: Module, node: ast.AST
+    ) -> Optional[Tuple[str, ast.AST]]:
+        """Follow the value of ``node`` upward through view-preserving
+        constructs.  Returns (escape-kind, carrier-node) or None when the
+        value is consumed/copied before it can escape."""
+        while True:
+            par = module.parent(node)
+            if par is None:
+                return None
+            if isinstance(par, ast.Call):
+                d = dotted(par.func) or ""
+                if node is par.func:
+                    return None
+                if d in SAFE_CALLS:
+                    return None
+                if d in TREE_MAP and par.args and (
+                    dotted(par.args[0]) in COPYING_FNS
+                ):
+                    return None  # the host_copy idiom itself
+                if d in PASSTHROUGH_CALLS:
+                    node = par
+                    continue
+                return None  # consumed by a call we can't see through
+            if isinstance(par, ast.Attribute) and par.value is node:
+                gp = module.parent(par)
+                if isinstance(gp, ast.Call) and gp.func is par:
+                    if par.attr in COPY_METHODS:
+                        return None
+                    node = gp  # assume view-preserving method (.reshape)
+                    continue
+                return None
+            if isinstance(par, ast.Subscript) and par.value is node:
+                gp = module.parent(par)
+                if isinstance(gp, ast.Assign) and par in gp.targets:
+                    return None  # store INTO the container, not an escape
+                node = par  # indexing a view yields a view
+                continue
+            if isinstance(par, ast.Starred):
+                node = par
+                continue
+            if isinstance(par, (ast.Tuple, ast.List, ast.Dict, ast.Set)):
+                gp = module.parent(par)
+                if isinstance(gp, ast.Assign) and par in gp.targets:
+                    return None  # unpacking target, handled by caller
+                node = par
+                continue
+            if isinstance(par, ast.Return):
+                return ("returned", par)
+            if isinstance(par, (ast.Yield, ast.YieldFrom)):
+                return ("yielded", par)
+            if isinstance(par, ast.Assign):
+                return ("assigned", par)
+            if isinstance(par, ast.AnnAssign) and par.value is node:
+                return ("assigned", par)
+            return None  # comprehension / boolop / anything else: silent
+
+    def _classify(self, module: Module, call: ast.Call) -> Optional[Finding]:
+        esc = self._walk_up(module, call)
+        if esc is None:
+            return None
+        kind, carrier = esc
+        scope = module.scope_of(call)
+        if kind in ("returned", "yielded"):
+            return Finding(
+                self.id,
+                module.path,
+                call.lineno,
+                scope,
+                f"device_get result {kind} without host_copy — on CPU this "
+                f"is a zero-copy view that donation can corrupt",
+            )
+        # assigned: attribute target escapes immediately; name targets
+        # escape if the name is later returned/yielded/stored/closed over
+        assert isinstance(carrier, (ast.Assign, ast.AnnAssign))
+        targets = (
+            carrier.targets
+            if isinstance(carrier, ast.Assign)
+            else [carrier.target]
+        )
+        names: List[str] = []
+        for t in targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                return Finding(
+                    self.id,
+                    module.path,
+                    call.lineno,
+                    scope,
+                    "device_get view stored on an object/container "
+                    "without host_copy",
+                )
+            for leaf in ast.walk(t):
+                if isinstance(leaf, ast.Name) and isinstance(
+                    leaf.ctx, ast.Store
+                ):
+                    names.append(leaf.id)
+        owner = module.enclosing_def(call)
+        if owner is None:
+            return None  # module-level assignment: import-time, no steps yet
+        for name in names:
+            hit = self._name_escapes(module, owner, name, carrier.lineno)
+            if hit is not None:
+                how, line = hit
+                return Finding(
+                    self.id,
+                    module.path,
+                    call.lineno,
+                    scope,
+                    f"device_get view bound to `{name}` is {how} "
+                    f"(line {line}) without host_copy",
+                )
+        return None
+
+    def _name_escapes(
+        self, module: Module, owner: ast.AST, name: str, after: int
+    ) -> Optional[Tuple[str, int]]:
+        for n in ast.walk(owner):
+            if not (
+                isinstance(n, ast.Name)
+                and n.id == name
+                and isinstance(n.ctx, ast.Load)
+                and n.lineno >= after
+            ):
+                continue
+            if module.enclosing_def(n) is not owner:
+                return ("captured by a nested function", n.lineno)
+            esc = self._walk_up(module, n)
+            if esc is None:
+                continue
+            kind, carrier = esc
+            if kind in ("returned", "yielded"):
+                return (kind, n.lineno)
+            if kind == "assigned":
+                targets = (
+                    carrier.targets
+                    if isinstance(carrier, ast.Assign)
+                    else [carrier.target]
+                )
+                if any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in targets
+                ):
+                    return ("stored on an object", n.lineno)
+        return None
+
+
+class UseAfterDonate(Rule):
+    id = "R2"
+    name = "use-after-donate"
+
+    JIT_WRAPPERS = {"jax.jit", "jax.pmap", "jit", "pmap"}
+    DONATING_FACTORIES = {"make_train_step", "make_parallel_train_step"}
+
+    def check_module(self, module: Module) -> List[Finding]:
+        out: List[Finding] = []
+        scopes = [module.tree] + [
+            n
+            for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            out.extend(self._check_scope(module, scope))
+        return out
+
+    def _donated_positions(self, call: ast.Call) -> Optional[Set[int]]:
+        d = dotted(call.func) or ""
+        if d in self.JIT_WRAPPERS:
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    val = kw.value
+                    if isinstance(val, ast.IfExp):
+                        val = val.body  # model the donating branch
+                    if isinstance(val, ast.Constant) and isinstance(
+                        val.value, int
+                    ):
+                        return {val.value}
+                    if isinstance(val, ast.Tuple) and all(
+                        isinstance(e, ast.Constant) for e in val.elts
+                    ):
+                        return {e.value for e in val.elts}
+                    return None
+            return None
+        if d.split(".")[-1] in self.DONATING_FACTORIES:
+            for kw in call.keywords:
+                if kw.arg == "donate" and isinstance(kw.value, ast.Constant):
+                    return {0} if kw.value.value is True else None
+            # make_train_step donates by default
+            return {0}
+        return None
+
+    def _check_scope(self, module: Module, scope: ast.AST) -> List[Finding]:
+        body_nodes = [
+            n
+            for n in ast.walk(scope)
+            if module.enclosing_def(n)
+            is (scope if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)) else None)
+        ]
+        donating: Dict[str, str] = {}  # callable name -> positions repr
+        positions: Dict[str, Set[int]] = {}
+        for n in body_nodes:
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                pos = self._donated_positions(n.value)
+                if pos:
+                    for t in n.targets:
+                        name = dotted(t)
+                        if name:
+                            donating[name] = dotted(n.value.func) or "?"
+                            positions[name] = pos
+
+        if not donating:
+            return []
+
+        # events: (line, priority, kind, payload)
+        events: List[Tuple[int, int, str, Tuple]] = []
+        for n in body_nodes:
+            if isinstance(n, ast.Call):
+                callee = dotted(n.func)
+                if callee in donating:
+                    for i in sorted(positions[callee]):
+                        if i < len(n.args) and isinstance(
+                            n.args[i], ast.Name
+                        ):
+                            events.append(
+                                (
+                                    n.end_lineno or n.lineno,
+                                    1,
+                                    "donate",
+                                    (n.args[i].id, callee, n.lineno),
+                                )
+                            )
+            if isinstance(n, ast.Name):
+                if isinstance(n.ctx, ast.Load):
+                    events.append((n.lineno, 0, "load", (n.id, n.lineno)))
+                elif isinstance(n.ctx, ast.Store):
+                    stmt = n
+                    while module.parent(stmt) is not None and not isinstance(
+                        stmt, ast.stmt
+                    ):
+                        stmt = module.parent(stmt)
+                    events.append(
+                        (stmt.end_lineno or n.lineno, 2, "store", (n.id,))
+                    )
+
+        events.sort(key=lambda e: (e[0], e[1]))
+        out: List[Finding] = []
+        live: Dict[str, Tuple[str, int]] = {}
+        flagged: Set[str] = set()
+        for _, _, kind, payload in events:
+            if kind == "donate":
+                name, callee, line = payload
+                live[name] = (callee, line)
+            elif kind == "store":
+                live.pop(payload[0], None)
+            elif kind == "load":
+                name, line = payload
+                if name in live and name not in flagged:
+                    callee, dline = live[name]
+                    flagged.add(name)
+                    out.append(
+                        Finding(
+                            self.id,
+                            module.path,
+                            line,
+                            module.scope_of(scope)
+                            if not isinstance(scope, ast.Module)
+                            else "<module>",
+                            f"`{name}` read after being donated to "
+                            f"`{callee}` (donating call at line {dline}) — "
+                            f"its device buffer may already be reused",
+                        )
+                    )
+        return out

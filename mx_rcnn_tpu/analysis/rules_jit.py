@@ -1,0 +1,168 @@
+"""R3 jit purity — traced bodies must not touch host state.
+
+A function that ends up inside ``jax.jit``/``jax.pmap`` runs ONCE per
+compile, not once per step.  Three hazard classes this rule catches:
+
+* mutating nonlocal/closure state (``global``/``nonlocal`` declarations,
+  attribute stores on closed-over objects) — silently freezes at trace
+  time, or worse, fires once per recompile;
+* calling the ``utils.faults`` injection hooks — their env-driven
+  side effects are host code and would be baked into (or elided from)
+  the compiled program depending on compile-time state;
+* branching on ``.item()``/``float()``/``int()``/``bool()`` of a traced
+  value in an ``if``/``while`` test — either a trace error or a
+  data-dependent recompile per distinct value (the ROOFLINE recompile
+  hazard).
+
+Jitted bodies are found two ways: decorator form (``@jax.jit``,
+``@partial(jax.jit, ...)``) and wrapper form — a ``def f`` whose NAME is
+later passed as the first argument to ``jax.jit``/``jax.pmap`` anywhere
+in the module (the project's dominant idiom: ``jax.jit(fwd, **kw)``,
+``jax.jit(sharded_step, donate_argnums=...)``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from mx_rcnn_tpu.analysis.engine import Finding, Module, Rule, dotted
+
+JIT_NAMES = {"jax.jit", "jax.pmap", "jit", "pmap"}
+PARTIAL_NAMES = {"partial", "functools.partial"}
+SCALARIZERS = {"float", "int", "bool"}
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    d = dotted(dec)
+    if d in JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        dc = dotted(dec.func)
+        if dc in JIT_NAMES:
+            return True
+        if dc in PARTIAL_NAMES and dec.args and dotted(dec.args[0]) in JIT_NAMES:
+            return True
+    return False
+
+
+class JitPurity(Rule):
+    id = "R3"
+    name = "jit purity"
+
+    def check_module(self, module: Module) -> List[Finding]:
+        jitted_names: Set[str] = set()
+        for n in ast.walk(module.tree):
+            if (
+                isinstance(n, ast.Call)
+                and dotted(n.func) in JIT_NAMES
+                and n.args
+                and isinstance(n.args[0], ast.Name)
+            ):
+                jitted_names.add(n.args[0].id)
+
+        out: List[Finding] = []
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if any(_decorator_is_jit(d) for d in fn.decorator_list) or (
+                fn.name in jitted_names
+            ):
+                out.extend(self._check_body(module, fn))
+        return out
+
+    def _check_body(self, module: Module, fn: ast.AST) -> List[Finding]:
+        out: List[Finding] = []
+        scope = module.scope_of(fn)
+        local: Set[str] = {a.arg for a in fn.args.args}
+        local.update(a.arg for a in fn.args.posonlyargs)
+        local.update(a.arg for a in fn.args.kwonlyargs)
+        if fn.args.vararg:
+            local.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            local.add(fn.args.kwarg.arg)
+        own = [n for n in ast.walk(fn) if module.enclosing_def(n) is fn]
+        for n in own:
+            if isinstance(n, ast.Name) and isinstance(
+                n.ctx, (ast.Store, ast.Del)
+            ):
+                local.add(n.id)
+
+        for n in own:
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                out.append(
+                    Finding(
+                        self.id,
+                        module.path,
+                        n.lineno,
+                        scope,
+                        f"jitted body declares "
+                        f"{'global' if isinstance(n, ast.Global) else 'nonlocal'} "
+                        f"{', '.join(n.names)} — traced once, not per step",
+                    )
+                )
+            if isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    n.targets if isinstance(n, ast.Assign) else [n.target]
+                )
+                for t in targets:
+                    root = t
+                    while isinstance(root, (ast.Attribute, ast.Subscript)):
+                        root = root.value
+                    if (
+                        isinstance(t, (ast.Attribute, ast.Subscript))
+                        and isinstance(root, ast.Name)
+                        and root.id not in local
+                    ):
+                        out.append(
+                            Finding(
+                                self.id,
+                                module.path,
+                                n.lineno,
+                                scope,
+                                f"jitted body mutates closed-over object "
+                                f"`{root.id}` — side effect happens at "
+                                f"trace time only",
+                            )
+                        )
+            if isinstance(n, ast.Call):
+                d = dotted(n.func) or ""
+                if d.startswith("faults."):
+                    out.append(
+                        Finding(
+                            self.id,
+                            module.path,
+                            n.lineno,
+                            scope,
+                            f"faults hook `{d}` called inside a jitted body "
+                            f"— injection state is compile-time, not "
+                            f"per-step",
+                        )
+                    )
+            if isinstance(n, (ast.If, ast.While)):
+                hazard = self._host_branch(n.test)
+                if hazard:
+                    out.append(
+                        Finding(
+                            self.id,
+                            module.path,
+                            n.lineno,
+                            scope,
+                            f"jitted body branches on `{hazard}` of a traced "
+                            f"value — trace error or per-value recompile",
+                        )
+                    )
+        return out
+
+    def _host_branch(self, test: ast.AST) -> Optional[str]:
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d in SCALARIZERS:
+                    return f"{d}()"
+                if (
+                    isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "item"
+                ):
+                    return ".item()"
+        return None

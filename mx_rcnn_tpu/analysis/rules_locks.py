@@ -1,0 +1,462 @@
+"""R4 lock order + device-work-under-lock across the serve stack.
+
+Builds a static lock-acquisition graph over every class in
+``mx_rcnn_tpu/serve/``:
+
+* lock attributes are assignments of ``threading.Lock/RLock/Condition``
+  or the project's ``make_lock("Name")`` / ``make_condition("Name")``
+  (lockcheck.py) to ``self.<attr>``;
+* a ``with self._lock:`` (or ``with other._lock:`` where ``other``'s
+  class is resolvable) acquires that lock for the lexical extent of the
+  block;
+* method calls inside a held block propagate the callee's own (direct +
+  transitive) acquisitions, computed to a fixed point.  Receivers are
+  resolved by constructor typing (``self.batcher = DynamicBatcher(...)``),
+  a small table of project attribute/parameter naming conventions, or —
+  failing both — by method-name uniqueness across the analyzed classes.
+
+Findings:
+
+* a CYCLE in the resulting lock-name graph (potential deadlock);
+* re-acquisition of a non-reentrant lock on some call path (guaranteed
+  deadlock; RLocks are exempt);
+* ``jax.device_put`` / ``jax.jit`` / ``jax.device_get`` (directly or
+  transitively) executed while holding a serve lock — device placement
+  and compiles take arbitrarily long and must not serialize the
+  serving control plane (the PR 7 post-stop-placement rule).
+
+The runtime counterpart is ``analysis/lockcheck.py``
+(MX_RCNN_LOCK_CHECK=1), which catches inversions this lexical analysis
+cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from mx_rcnn_tpu.analysis.engine import Finding, Module, Rule, dotted
+
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "make_lock",
+              "lockcheck.make_lock"}
+COND_CTORS = {"threading.Condition", "make_condition",
+              "lockcheck.make_condition"}
+RLOCK_CTORS = {"threading.RLock"}
+DEVICE_CALLS = {"jax.device_put", "jax.device_get", "jax.jit", "jax.pmap"}
+
+# project attribute/parameter naming conventions (documented fallback
+# when constructor typing can't resolve a receiver)
+NAME_HINTS = {
+    "registry": "ModelRegistry",
+    "reg": "ModelRegistry",
+    "batcher": "DynamicBatcher",
+    "pool": "ReplicaPool",
+    "slot": "_ModelSlot",
+    "runner": "ServeRunner",
+    "replica": "Replica",
+    "primary": "Replica",
+    "backup": "Replica",
+    "compile_cache": "CompileCache",
+}
+
+
+class _ClassInfo:
+    def __init__(self, name: str, module: Module, node: ast.ClassDef):
+        self.name = name
+        self.module = module
+        self.node = node
+        self.locks: Dict[str, bool] = {}  # attr -> is_reentrant
+        self.attr_types: Dict[str, str] = {}
+        self.methods: Dict[str, ast.FunctionDef] = {}
+
+
+class _MethodInfo:
+    def __init__(self, cls: _ClassInfo, node: ast.FunctionDef):
+        self.cls = cls
+        self.node = node
+        # direct acquisitions: (lock qualname "Class.attr", reentrant)
+        self.direct: Set[Tuple[str, bool]] = set()
+        self.direct_device: List[ast.Call] = []
+        # (held locks at site, callee class or None, callee name, node)
+        self.calls: List[
+            Tuple[Tuple[Tuple[str, bool], ...], Optional[str], str, ast.AST]
+        ] = []
+        # fixed-point results
+        self.all_locks: Set[Tuple[str, bool]] = set()
+        self.uses_device = False
+
+
+def _lock_ctor_kind(call: ast.Call) -> Optional[bool]:
+    """None if not a lock ctor, else is_reentrant."""
+    d = dotted(call.func) or ""
+    if d in RLOCK_CTORS:
+        return True
+    if d in LOCK_CTORS:
+        for kw in call.keywords:
+            if kw.arg == "rlock" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return False
+    if d in COND_CTORS:
+        # Condition() defaults to RLock underneath; make_condition uses a
+        # plain named lock but is never re-entered by the stack
+        return d == "threading.Condition" and not call.args
+    return None
+
+
+class LockOrder(Rule):
+    id = "R4"
+    name = "lock order"
+
+    def _in_scope(self, module: Module) -> bool:
+        return "/serve/" in f"/{module.path}"
+
+    def finalize(self, modules: Sequence[Module]) -> List[Finding]:
+        classes: Dict[str, _ClassInfo] = {}
+        for m in modules:
+            if not self._in_scope(m):
+                continue
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.ClassDef):
+                    classes[node.name] = self._scan_class(m, node)
+        if not classes:
+            return []
+
+        methods: Dict[Tuple[str, str], _MethodInfo] = {}
+        for ci in classes.values():
+            for mname, fn in ci.methods.items():
+                methods[(ci.name, mname)] = self._scan_method(
+                    ci, fn, classes
+                )
+
+        self._fixed_point(methods, classes)
+        out: List[Finding] = []
+        edges: Dict[str, Set[str]] = {}
+        edge_site: Dict[Tuple[str, str], Tuple[Module, int, str]] = {}
+
+        for (cname, mname), mi in methods.items():
+            scope = f"{cname}.{mname}"
+            for held, callee_cls, callee_name, node in mi.calls:
+                if not held:
+                    continue
+                target = self._resolve(callee_cls, callee_name, methods)
+                if target is None:
+                    continue
+                tinfo = methods[target]
+                for lock, reentrant in tinfo.all_locks:
+                    for hname, hre in held:
+                        if hname == lock:
+                            if not (reentrant and hre):
+                                out.append(
+                                    Finding(
+                                        self.id,
+                                        mi.cls.module.path,
+                                        node.lineno,
+                                        scope,
+                                        f"call path re-acquires non-"
+                                        f"reentrant lock {lock} while "
+                                        f"already held",
+                                    )
+                                )
+                            continue
+                        if lock not in edges.setdefault(hname, set()):
+                            edges[hname].add(lock)
+                            edge_site[(hname, lock)] = (
+                                mi.cls.module,
+                                node.lineno,
+                                scope,
+                            )
+                if tinfo.uses_device:
+                    out.append(
+                        Finding(
+                            self.id,
+                            mi.cls.module.path,
+                            node.lineno,
+                            scope,
+                            f"device/compile work reached while holding "
+                            f"{', '.join(h for h, _ in held)} — placement "
+                            f"and compiles must not run under serve locks",
+                        )
+                    )
+            for call in mi.direct_device:
+                held = self._held_at(mi, call)
+                if held:
+                    out.append(
+                        Finding(
+                            self.id,
+                            mi.cls.module.path,
+                            call.lineno,
+                            scope,
+                            f"`{dotted(call.func)}` called while holding "
+                            f"{', '.join(h for h, _ in held)} — placement "
+                            f"and compiles must not run under serve locks",
+                        )
+                    )
+
+        out.extend(self._find_cycles(edges, edge_site))
+        return out
+
+    # ---- class/method scanning -------------------------------------
+
+    def _scan_class(self, m: Module, node: ast.ClassDef) -> _ClassInfo:
+        ci = _ClassInfo(node.name, m, node)
+        for child in node.body:
+            if isinstance(child, ast.FunctionDef):
+                ci.methods[child.name] = child
+        for n in ast.walk(node):
+            if not (isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)):
+                continue
+            for t in n.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    kind = _lock_ctor_kind(n.value)
+                    if kind is not None:
+                        ci.locks[t.attr] = kind
+                    else:
+                        ctor = dotted(n.value.func)
+                        if ctor:
+                            ci.attr_types[t.attr] = ctor.split(".")[-1]
+            # element typing for replica lists: self.xs = [Cls(...) ...]
+            if isinstance(n.value, ast.ListComp) and isinstance(
+                n.value.elt, ast.Call
+            ):
+                ctor = dotted(n.value.elt.func)
+                for t in n.targets:
+                    if (
+                        ctor
+                        and isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        ci.attr_types[t.attr] = ctor.split(".")[-1]
+        return ci
+
+    def _resolve_receiver_type(
+        self,
+        expr: ast.AST,
+        ci: _ClassInfo,
+        classes: Dict[str, _ClassInfo],
+        aliases: Dict[str, str],
+    ) -> Optional[str]:
+        d = dotted(expr)
+        if d is None:
+            return None
+        d = aliases.get(d, d)
+        if d == "self":
+            return ci.name
+        parts = d.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            attr = parts[1]
+            if attr in ci.attr_types and ci.attr_types[attr] in classes:
+                return ci.attr_types[attr]
+            if attr in NAME_HINTS and NAME_HINTS[attr] in classes:
+                return NAME_HINTS[attr]
+            return None
+        if len(parts) == 1:
+            hint = NAME_HINTS.get(parts[0])
+            if hint in classes:
+                return hint
+        return None
+
+    def _local_aliases(self, fn: ast.FunctionDef) -> Dict[str, str]:
+        """name -> dotted origin for trivial assigns incl. tuple unpack
+        (``reg, e = self.registry, self.entry``)."""
+        out: Dict[str, str] = {}
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+                continue
+            t, v = n.targets[0], n.value
+            if isinstance(t, ast.Name):
+                src = dotted(v)
+                if src:
+                    out[t.id] = src
+            elif (
+                isinstance(t, ast.Tuple)
+                and isinstance(v, ast.Tuple)
+                and len(t.elts) == len(v.elts)
+            ):
+                for te, ve in zip(t.elts, v.elts):
+                    if isinstance(te, ast.Name):
+                        src = dotted(ve)
+                        if src:
+                            out[te.id] = src
+        return out
+
+    def _lock_of_with_item(
+        self,
+        expr: ast.AST,
+        ci: _ClassInfo,
+        classes: Dict[str, _ClassInfo],
+        aliases: Dict[str, str],
+    ) -> Optional[Tuple[str, bool]]:
+        if not isinstance(expr, ast.Attribute):
+            return None
+        owner_type = self._resolve_receiver_type(
+            expr.value, ci, classes, aliases
+        )
+        if owner_type is None and isinstance(expr.value, ast.Name):
+            hint = NAME_HINTS.get(aliases.get(expr.value.id, expr.value.id))
+            if hint in classes:
+                owner_type = hint
+        if owner_type is None:
+            return None
+        oc = classes.get(owner_type)
+        if oc and expr.attr in oc.locks:
+            return (f"{owner_type}.{expr.attr}", oc.locks[expr.attr])
+        return None
+
+    def _scan_method(
+        self,
+        ci: _ClassInfo,
+        fn: ast.FunctionDef,
+        classes: Dict[str, _ClassInfo],
+    ) -> _MethodInfo:
+        mi = _MethodInfo(ci, fn)
+        aliases = self._local_aliases(fn)
+        mi._aliases = aliases
+        mi._classes = classes
+
+        def walk(stmts, held: Tuple[Tuple[str, bool], ...]):
+            for s in stmts:
+                if isinstance(s, ast.With):
+                    locks = []
+                    for item in s.items:
+                        lk = self._lock_of_with_item(
+                            item.context_expr, ci, classes, aliases
+                        )
+                        if lk:
+                            locks.append(lk)
+                            mi.direct.add(lk)
+                    inner = held + tuple(locks)
+                    self._scan_exprs(s.items, mi, ci, classes, aliases, held)
+                    walk(s.body, inner)
+                    continue
+                self._scan_stmt(s, mi, ci, classes, aliases, held, walk)
+
+        walk(fn.body, ())
+        return mi
+
+    def _scan_stmt(self, s, mi, ci, classes, aliases, held, walk):
+        # recurse into compound statements, keeping held set
+        if isinstance(s, (ast.If,)):
+            self._scan_exprs([s.test], mi, ci, classes, aliases, held)
+            walk(s.body, held)
+            walk(s.orelse, held)
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            self._scan_exprs([s.iter], mi, ci, classes, aliases, held)
+            walk(s.body, held)
+            walk(s.orelse, held)
+        elif isinstance(s, ast.While):
+            self._scan_exprs([s.test], mi, ci, classes, aliases, held)
+            walk(s.body, held)
+            walk(s.orelse, held)
+        elif isinstance(s, ast.Try):
+            walk(s.body, held)
+            for h in s.handlers:
+                walk(h.body, held)
+            walk(s.orelse, held)
+            walk(s.finalbody, held)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs execute later; analyze with empty held set
+            walk(s.body, ())
+        else:
+            self._scan_exprs([s], mi, ci, classes, aliases, held)
+
+    def _scan_exprs(self, nodes, mi, ci, classes, aliases, held):
+        for root in nodes:
+            for n in ast.walk(root):
+                if not isinstance(n, ast.Call):
+                    continue
+                d = dotted(n.func) or ""
+                if d in DEVICE_CALLS:
+                    mi.direct_device.append(n)
+                    mi._device_held = getattr(mi, "_device_held", {})
+                    mi._device_held[id(n)] = held
+                    continue
+                if isinstance(n.func, ast.Attribute):
+                    recv_type = self._resolve_receiver_type(
+                        n.func.value, ci, classes, aliases
+                    )
+                    mi.calls.append((held, recv_type, n.func.attr, n))
+                elif isinstance(n.func, ast.Name):
+                    # bare call: constructor of an analyzed class?
+                    if n.func.id in classes:
+                        mi.calls.append((held, n.func.id, "__init__", n))
+
+    def _held_at(self, mi: _MethodInfo, call: ast.Call):
+        return getattr(mi, "_device_held", {}).get(id(call), ())
+
+    # ---- propagation + cycles --------------------------------------
+
+    def _resolve(
+        self,
+        cls: Optional[str],
+        name: str,
+        methods: Dict[Tuple[str, str], _MethodInfo],
+    ) -> Optional[Tuple[str, str]]:
+        if cls is not None:
+            return (cls, name) if (cls, name) in methods else None
+        owners = [k for k in methods if k[1] == name]
+        return owners[0] if len(owners) == 1 else None
+
+    def _fixed_point(self, methods, classes) -> None:
+        for mi in methods.values():
+            mi.all_locks = set(mi.direct)
+            mi.uses_device = bool(mi.direct_device)
+        changed = True
+        iters = 0
+        while changed and iters < 50:
+            changed = False
+            iters += 1
+            for mi in methods.values():
+                for held, cls, name, _ in mi.calls:
+                    target = self._resolve(cls, name, methods)
+                    if target is None:
+                        continue
+                    ti = methods[target]
+                    if not ti.all_locks.issubset(mi.all_locks):
+                        mi.all_locks |= ti.all_locks
+                        changed = True
+                    if ti.uses_device and not mi.uses_device:
+                        mi.uses_device = True
+                        changed = True
+
+    def _find_cycles(self, edges, edge_site) -> List[Finding]:
+        out: List[Finding] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        for start in sorted(edges):
+            path: List[str] = []
+            on_path: Set[str] = set()
+
+            def dfs(n: str) -> None:
+                if n in on_path:
+                    cyc = path[path.index(n):] + [n]
+                    key = tuple(sorted(set(cyc)))
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        mod, line, scope = edge_site.get(
+                            (cyc[0], cyc[1]), (None, 0, "<graph>")
+                        )
+                        out.append(
+                            Finding(
+                                self.id,
+                                mod.path if mod else "<serve>",
+                                line,
+                                scope,
+                                "lock-order cycle: " + " -> ".join(cyc),
+                            )
+                        )
+                    return
+                if n in path:
+                    return
+                path.append(n)
+                on_path.add(n)
+                for nxt in sorted(edges.get(n, ())):
+                    dfs(nxt)
+                path.pop()
+                on_path.discard(n)
+
+            dfs(start)
+        return out

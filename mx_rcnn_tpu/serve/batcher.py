@@ -28,6 +28,8 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
+
+from mx_rcnn_tpu.analysis.lockcheck import make_condition
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -85,7 +87,7 @@ class DynamicBatcher:
         self._queues: Dict[Tuple, deque] = {}
         self._count = 0
         self._closed = False
-        self._cond = threading.Condition()
+        self._cond = make_condition("DynamicBatcher._cond")
 
     # ------------------------------------------------------------- producers
     def submit(self, req: Request) -> None:

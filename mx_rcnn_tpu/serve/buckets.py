@@ -28,6 +28,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Iterator, Sequence, Tuple
 
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+
 
 class BucketOverflow(ValueError):
     """The (resized) image does not fit any serving bucket — the request
@@ -76,7 +78,7 @@ class CompileCache:
     while warmup/tests read the counters."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("CompileCache._lock")
         self._keys: set = set()
         self.hits = 0
         self.misses = 0

@@ -47,6 +47,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from mx_rcnn_tpu.core.resilience import RetryPolicy, make_retry_policy
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
 from mx_rcnn_tpu.data.assembler import CompletionPool
 from mx_rcnn_tpu.serve.batcher import DynamicBatcher, QueueFull, Request
 from mx_rcnn_tpu.serve.metrics import ServeMetrics
@@ -91,7 +92,7 @@ class ServingEngine:
         # every not-yet-resolved request, so stop() can sweep leftovers
         # with a terminal EngineStopped instead of stranding submitters
         self._live: Dict[int, Request] = {}
-        self._live_lock = threading.Lock()
+        self._live_lock = make_lock("ServingEngine._live_lock")
 
     # ---------------------------------------------------------- lifecycle
     def start(self, warmup: bool = True) -> "ServingEngine":

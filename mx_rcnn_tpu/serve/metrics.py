@@ -22,6 +22,8 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+
 
 class LatencyHistogram:
     """Log-spaced latency histogram, milliseconds domain.
@@ -35,7 +37,7 @@ class LatencyHistogram:
         # upper edges of `bins` geometric bins; one extra overflow bucket
         self._edges = np.geomspace(lo_ms, hi_ms, bins)
         self._counts = np.zeros(bins + 1, np.int64)
-        self._lock = threading.Lock()
+        self._lock = make_lock("LatencyHistogram._lock")
         self.count = 0
         self.total_ms = 0.0
         self.max_ms = 0.0
@@ -106,7 +108,7 @@ class ServeMetrics:
     compile counters."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("ServeMetrics._lock")
         # request-path histograms
         self.queue_wait = LatencyHistogram()    # enqueue → batch pickup
         self.service = LatencyHistogram()       # device dispatch → outputs

@@ -65,6 +65,7 @@ from collections import OrderedDict
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
 from mx_rcnn_tpu.core.checkpoint import restore_tree, verify_manifest
 from mx_rcnn_tpu.utils import faults
 
@@ -168,7 +169,7 @@ class ModelRegistry:
     """Owner of every model family's versioned, swappable params."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = make_lock("ModelRegistry._lock", rlock=True)
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
         self._swaps: Dict[str, "SwapController"] = {}
         self._swap_ordinal = 0

@@ -75,6 +75,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
 from mx_rcnn_tpu.core.resilience import RetryPolicy, make_retry_policy
 from mx_rcnn_tpu.serve.metrics import LatencyHistogram
 from mx_rcnn_tpu.utils import faults
@@ -159,7 +160,7 @@ class Replica:
         self.policy = policy or HealthPolicy()
         self._factory = runner_factory
         self.runner = runner_factory(self.index)
-        self._lock = threading.Lock()
+        self._lock = make_lock("Replica._lock")
         self._inbox: "queue.Queue[Optional[_Dispatch]]" = queue.Queue()
         self._current: Optional[_Dispatch] = None
         self._watchdog: Optional[threading.Timer] = None

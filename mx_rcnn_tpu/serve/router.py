@@ -48,6 +48,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
 from mx_rcnn_tpu.serve.metrics import LatencyHistogram
 from mx_rcnn_tpu.serve.replica import (
     HealthPolicy,
@@ -113,7 +114,7 @@ class ReplicaPool:
             Replica(i, runner_factory, policy=self.policy)
             for i in range(n_replicas)
         ]
-        self._lock = threading.Lock()
+        self._lock = make_lock("ReplicaPool._lock")
         # pool-level routing counters
         self.dispatched = 0
         self.completed = 0

@@ -38,6 +38,7 @@ from mx_rcnn_tpu.data.image import (
     resize_im,
 )
 from mx_rcnn_tpu.native.hostops import nms_host
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
 from mx_rcnn_tpu.serve.batcher import Request
 from mx_rcnn_tpu.serve.buckets import BucketLadder, CompileCache
 
@@ -169,7 +170,7 @@ class _ModelSlot:
         self.cfg = cfg
         self.num_classes = int(num_classes)
         self.uint8 = bool(uint8)
-        self.lock = threading.Lock()
+        self.lock = make_lock("_ModelSlot.lock")
 
 
 class ServeRunner:
@@ -258,7 +259,7 @@ class ServeRunner:
         self.layout_staged = 0
         # registry-resolution state
         self._slots: Dict[str, _ModelSlot] = {}
-        self._slots_lock = threading.Lock()
+        self._slots_lock = make_lock("ServeRunner._slots_lock")
         self._staged: Dict[Tuple[str, int], object] = {}  # (model, ver) → tree
         self.served_buckets: Dict[str, set] = {}
         self.swaps_applied = 0

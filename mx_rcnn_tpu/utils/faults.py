@@ -109,6 +109,20 @@ _SWAP_KINDS = {
     "canary": "canary_fail",
 }
 
+# every kind some hook consults — graftlint R6 cross-checks this against
+# the hook bodies, so the whitelist cannot drift from the implementation
+_KNOWN_KINDS = frozenset(
+    {
+        "nan_loss",
+        "spike",
+        "record_fail",
+        "save_crash",
+        "stall",
+    }
+    | set(_SERVE_KINDS)
+    | set(_SWAP_KINDS.values())
+)
+
 
 @dataclass
 class _Fault:
@@ -153,6 +167,13 @@ def _parse(spec: str) -> List[_Fault]:
         if not entry:
             continue
         kind, _, rest = entry.partition("@")
+        if kind not in _KNOWN_KINDS:
+            # a typo'd injector (``predict_fial@...``) must be a hard
+            # error, not a fault matrix that silently tests nothing
+            raise ValueError(
+                f"MX_RCNN_FAULTS: unknown injector kind {kind!r} in entry "
+                f"{entry!r}; known kinds: {', '.join(sorted(_KNOWN_KINDS))}"
+            )
         arg_s = None
         if ":" in rest:
             rest, _, arg_s = rest.partition(":")

@@ -1,0 +1,616 @@
+"""graftlint self-tests: per-rule good/bad fixture matrix, suppression
+machinery (inline pragma, baseline, stale detection), the whole-tree
+zero-noise guarantee, the runtime lock-order proxy, the faults-spec
+hard error, and the BENCH artifact parse guard.
+
+Everything here is stdlib + numpy speed — no jax execution, so the
+whole file runs in well under a second of tier-1 budget."""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from mx_rcnn_tpu.analysis import engine as eng
+from mx_rcnn_tpu.analysis import lockcheck
+from mx_rcnn_tpu.analysis.cli import check_bench_artifacts
+from mx_rcnn_tpu.analysis.rules_faults import FaultCoverage
+from mx_rcnn_tpu.analysis.rules_futures import ExactlyOnce
+from mx_rcnn_tpu.analysis.rules_hostcopy import HostCopyEscape, UseAfterDonate
+from mx_rcnn_tpu.analysis.rules_jit import JitPurity
+from mx_rcnn_tpu.analysis.rules_locks import LockOrder
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def run_rule(src, rule, path="mx_rcnn_tpu/core/mod.py"):
+    report = eng.analyze_snippets({path: src}, [rule])
+    return report.findings
+
+
+# ---------------------------------------------------------------- R1
+
+R1_BAD_RETURN = """
+import jax
+
+def f(fn, batch):
+    return jax.device_get(fn(batch))
+"""
+
+R1_BAD_CLOSURE = """
+import jax
+
+def g(params):
+    host = jax.device_get(params)
+
+    def rebuild():
+        return host
+
+    return rebuild
+"""
+
+R1_BAD_STORE = """
+import jax
+
+class Holder:
+    def grab(self, tree):
+        self.snapshot = jax.device_get(tree)
+"""
+
+R1_GOOD = """
+import jax
+import numpy as np
+
+def f(fn, batch):
+    out = jax.device_get(fn(batch))
+    return float(out["loss"].mean())
+
+def g(fn, batch):
+    return jax.tree_util.tree_map(np.array, jax.device_get(fn(batch)))
+
+def h(fn, batch, consume):
+    consume(jax.device_get(fn(batch)))
+"""
+
+
+def test_r1_fires_on_returned_view():
+    fs = run_rule(R1_BAD_RETURN, HostCopyEscape())
+    assert len(fs) == 1 and fs[0].rule == "R1" and fs[0].scope == "f"
+
+
+def test_r1_fires_on_closure_capture():
+    fs = run_rule(R1_BAD_CLOSURE, HostCopyEscape())
+    assert len(fs) == 1 and "nested function" in fs[0].message
+
+
+def test_r1_fires_on_attribute_store():
+    fs = run_rule(R1_BAD_STORE, HostCopyEscape())
+    assert len(fs) == 1 and "stored" in fs[0].message
+
+
+def test_r1_silent_on_consumed_and_copied():
+    assert run_rule(R1_GOOD, HostCopyEscape()) == []
+
+
+# ---------------------------------------------------------------- R2
+
+R2_BAD = """
+import jax
+
+def train(step, state, batch):
+    step2 = jax.jit(step, donate_argnums=(0,))
+    out = step2(state, batch)
+    return state, out
+"""
+
+R2_BAD_FACTORY = """
+from mx_rcnn_tpu.core.train import make_train_step
+
+def train(model, tx, state, batch, rng):
+    step = make_train_step(model, tx, donate=True)
+    new_state, aux = step(state, batch, rng)
+    print(state)
+    return new_state, aux
+"""
+
+R2_GOOD = """
+import jax
+
+def train(step, state, batch):
+    step2 = jax.jit(step, donate_argnums=(0,))
+    state = step2(state, batch)
+    return state
+"""
+
+
+def test_r2_fires_on_use_after_donate():
+    fs = run_rule(R2_BAD, UseAfterDonate())
+    assert len(fs) == 1 and "`state` read after being donated" in fs[0].message
+
+
+def test_r2_fires_on_factory_donation():
+    fs = run_rule(R2_BAD_FACTORY, UseAfterDonate())
+    assert len(fs) == 1
+    assert "`state` read after being donated to `step`" in fs[0].message
+
+
+def test_r2_silent_on_rebind():
+    assert run_rule(R2_GOOD, UseAfterDonate()) == []
+
+
+# ---------------------------------------------------------------- R3
+
+R3_BAD = """
+import jax
+from mx_rcnn_tpu.utils import faults
+
+seen = []
+
+@jax.jit
+def step(x):
+    global seen
+    faults.stall(0)
+    if float(x.sum()) > 0:
+        x = -x
+    return x
+"""
+
+R3_BAD_WRAPPED = """
+import jax
+
+def fwd(p, b):
+    if b["flag"].item() > 0:
+        return p
+    return b
+
+f = jax.jit(fwd, donate_argnums=(1,))
+"""
+
+R3_GOOD = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    y = jnp.where(x > 0, -x, x)
+    return y
+
+def helper(state):
+    # not jitted: host branching is fine here
+    if float(state.loss) > 1e4:
+        return None
+    return state
+"""
+
+
+def test_r3_fires_on_impure_jit_body():
+    fs = run_rule(R3_BAD, JitPurity())
+    msgs = " | ".join(f.message for f in fs)
+    assert len(fs) == 3
+    assert "global" in msgs and "faults.stall" in msgs and "float()" in msgs
+
+
+def test_r3_finds_wrapper_form_jit():
+    fs = run_rule(R3_BAD_WRAPPED, JitPurity())
+    assert len(fs) == 1 and ".item()" in fs[0].message
+
+
+def test_r3_silent_on_clean_and_unjitted():
+    assert run_rule(R3_GOOD, JitPurity()) == []
+
+
+# ---------------------------------------------------------------- R4
+
+R4_CYCLE = """
+import threading
+
+class Alpha:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.beta = None
+
+    def do_alpha(self):
+        with self._lock:
+            self.beta.do_beta()
+
+class Beta:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.alpha = None
+
+    def do_beta(self):
+        with self._lock:
+            pass
+
+    def call_back(self):
+        with self._lock:
+            self.alpha.do_alpha()
+"""
+
+R4_DEVICE = """
+import threading
+import jax
+
+class Holder:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad(self, tree):
+        with self._lock:
+            return jax.device_put(tree)
+
+    def good(self, tree):
+        out = jax.device_put(tree)
+        with self._lock:
+            self.count = 1
+        return out
+"""
+
+R4_GOOD = """
+import threading
+
+class Alpha:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.beta = None
+
+    def do_alpha(self):
+        with self._lock:
+            self.beta.do_beta()
+
+class Beta:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def do_beta(self):
+        with self._lock:
+            pass
+"""
+
+R4_MAKE_LOCK = """
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+import jax
+
+class Holder:
+    def __init__(self):
+        self._lock = make_lock("Holder._lock")
+
+    def bad(self, tree):
+        with self._lock:
+            return jax.jit(tree)
+"""
+
+
+def test_r4_fires_on_lock_cycle():
+    fs = run_rule(R4_CYCLE, LockOrder(), path="mx_rcnn_tpu/serve/fx.py")
+    assert any("cycle" in f.message for f in fs)
+
+
+def test_r4_fires_on_device_put_under_lock():
+    fs = run_rule(R4_DEVICE, LockOrder(), path="mx_rcnn_tpu/serve/fx.py")
+    assert len(fs) == 1
+    assert fs[0].scope == "Holder.bad" and "device" in fs[0].message
+
+
+def test_r4_recognizes_make_lock_spelling():
+    fs = run_rule(R4_MAKE_LOCK, LockOrder(), path="mx_rcnn_tpu/serve/fx.py")
+    assert len(fs) == 1 and "Holder._lock" in fs[0].message
+
+
+def test_r4_silent_on_one_way_order():
+    assert run_rule(R4_GOOD, LockOrder(), path="mx_rcnn_tpu/serve/fx.py") == []
+
+
+def test_r4_ignores_non_serve_modules():
+    assert run_rule(R4_DEVICE, LockOrder(), path="mx_rcnn_tpu/core/fx.py") == []
+
+
+# ---------------------------------------------------------------- R5
+
+R5_BAD = """
+class Worker:
+    def loop(self):
+        while True:
+            d = self._inbox.get()
+            if self._stop:
+                return
+            d.resolve(1)
+"""
+
+R5_GOOD = """
+class Worker:
+    def loop(self):
+        while True:
+            d = self._inbox.get(timeout=0.02)
+            if d is None:
+                break
+            self._serve(d)
+
+    def drain(self):
+        while True:
+            try:
+                d = self._inbox.get_nowait()
+            except Exception:
+                break
+            if d is not None:
+                d.resolve(None)
+"""
+
+
+def test_r5_fires_on_droppable_take():
+    fs = run_rule(R5_BAD, ExactlyOnce(), path="mx_rcnn_tpu/serve/fx.py")
+    assert len(fs) == 1 and "`d`" in fs[0].message
+
+
+def test_r5_silent_on_sentinel_and_drain():
+    assert run_rule(R5_GOOD, ExactlyOnce(), path="mx_rcnn_tpu/serve/fx.py") == []
+
+
+# ---------------------------------------------------------------- R6
+
+R6_FAULTS = """
+def _active():
+    return []
+
+def hook_a():
+    for f in _active():
+        if f.kind == "ka":
+            pass
+
+def hook_b():
+    for f in _active():
+        if f.kind == "kb":
+            pass
+"""
+
+R6_CALLER_OK = """
+from mx_rcnn_tpu.utils import faults
+
+def run():
+    faults.hook_a()
+    faults.hook_b()
+"""
+
+R6_CALLER_BAD = """
+from mx_rcnn_tpu.utils import faults
+
+def run():
+    faults.hook_a()
+    faults.missing_hook()
+"""
+
+FAULTS_PATH = "mx_rcnn_tpu/utils/faults.py"
+
+
+def test_r6_fires_on_uncovered_and_nonexistent_hooks():
+    report = eng.analyze_snippets(
+        {FAULTS_PATH: R6_FAULTS, "mx_rcnn_tpu/core/use.py": R6_CALLER_BAD},
+        [FaultCoverage()],
+    )
+    msgs = " | ".join(f.message for f in report.findings)
+    assert "missing_hook" in msgs and "hook_b" in msgs
+
+
+def test_r6_silent_when_hooks_covered():
+    report = eng.analyze_snippets(
+        {FAULTS_PATH: R6_FAULTS, "mx_rcnn_tpu/core/use.py": R6_CALLER_OK},
+        [FaultCoverage()],
+    )
+    assert report.findings == []
+
+
+def test_r6_fires_on_known_kinds_drift():
+    drift = R6_FAULTS + '\n_KNOWN_KINDS = frozenset({"ka"})\n'
+    report = eng.analyze_snippets(
+        {FAULTS_PATH: drift, "mx_rcnn_tpu/core/use.py": R6_CALLER_OK},
+        [FaultCoverage()],
+    )
+    assert any("_KNOWN_KINDS drift" in f.message for f in report.findings)
+    assert any("'kb'" in f.message for f in report.findings)
+
+
+# ------------------------------------------------- suppression layers
+
+
+def test_inline_pragma_suppresses_with_reason():
+    src = R1_BAD_RETURN.replace(
+        "return jax.device_get(fn(batch))",
+        "return jax.device_get(fn(batch))  "
+        "# graftlint: disable=R1(outputs never donated)",
+    )
+    report = eng.analyze_snippets(
+        {"mx_rcnn_tpu/core/mod.py": src}, [HostCopyEscape()]
+    )
+    assert report.findings == []
+    assert len(report.inline_suppressed) == 1
+    assert report.inline_suppressed[0][1] == "outputs never donated"
+
+
+def test_inline_pragma_without_reason_is_ignored():
+    src = R1_BAD_RETURN.replace(
+        "return jax.device_get(fn(batch))",
+        "return jax.device_get(fn(batch))  # graftlint: disable=R1",
+    )
+    report = eng.analyze_snippets(
+        {"mx_rcnn_tpu/core/mod.py": src}, [HostCopyEscape()]
+    )
+    assert len(report.findings) == 1
+
+
+def test_baseline_suppresses_and_flags_stale():
+    good = eng.BaselineEntry(
+        rule="R1", path="mx_rcnn_tpu/core/mod.py", scope="f", reason="known"
+    )
+    stale = eng.BaselineEntry(
+        rule="R1", path="mx_rcnn_tpu/core/gone.py", scope="g", reason="old"
+    )
+    report = eng.analyze_snippets(
+        {"mx_rcnn_tpu/core/mod.py": R1_BAD_RETURN},
+        [HostCopyEscape()],
+        baseline=[good, stale],
+    )
+    assert report.findings == []
+    assert len(report.baseline_suppressed) == 1
+    assert report.stale_baseline == [stale]
+    assert not report.ok  # stale entries fail the run
+
+
+# ------------------------------------------------- whole-tree guards
+
+
+@pytest.fixture(scope="module")
+def tree():
+    modules, errors = eng.load_modules(REPO)
+    baseline = eng.load_baseline(REPO / "tools" / "lint_baseline.json")
+    return modules, baseline, errors
+
+
+def test_tree_is_clean(tree):
+    modules, baseline, errors = tree
+    report = eng.analyze(modules, eng.default_rules(), baseline, errors)
+    detail = "\n".join(f.format() for f in report.findings)
+    assert report.ok, f"{report.summary()}\n{detail}"
+
+
+def test_fresh_r1_violation_fails_the_tree(tree):
+    modules, baseline, errors = tree
+    injected = eng.Module("mx_rcnn_tpu/core/_fresh_violation.py", R1_BAD_RETURN)
+    report = eng.analyze(
+        list(modules) + [injected], eng.default_rules(), baseline, errors
+    )
+    assert not report.ok
+    assert any(
+        f.rule == "R1" and f.path.endswith("_fresh_violation.py")
+        for f in report.findings
+    )
+
+
+def test_fabricated_stale_entry_fails_the_tree(tree):
+    modules, baseline, errors = tree
+    fake = eng.BaselineEntry(
+        rule="R1", path="mx_rcnn_tpu/core/nope.py", scope="*", reason="stale"
+    )
+    report = eng.analyze(
+        modules, eng.default_rules(), list(baseline) + [fake], errors
+    )
+    assert not report.ok and fake in report.stale_baseline
+
+
+# ------------------------------------------------- runtime lock check
+
+
+@pytest.fixture(autouse=True)
+def _fresh_lock_graph():
+    lockcheck.reset()
+    yield
+    lockcheck.reset()
+
+
+def test_lockcheck_raises_on_inversion():
+    a = lockcheck.OrderedLock("A")
+    b = lockcheck.OrderedLock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(lockcheck.LockOrderViolation):
+            a.acquire()
+
+
+def test_lockcheck_allows_consistent_order():
+    a = lockcheck.OrderedLock("A")
+    b = lockcheck.OrderedLock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+
+
+def test_lockcheck_same_name_instances_nest():
+    # LatencyHistogram.merge holds two instances of the same lock class
+    h1 = lockcheck.OrderedLock("H")
+    h2 = lockcheck.OrderedLock("H")
+    with h1:
+        with h2:
+            pass
+
+
+def test_lockcheck_rlock_reentry_ok_plain_reentry_raises():
+    r = lockcheck.OrderedLock("R", rlock=True)
+    with r:
+        with r:
+            pass
+    p = lockcheck.OrderedLock("P")
+    with p:
+        with pytest.raises(lockcheck.LockOrderViolation):
+            p.acquire()
+
+
+def test_lockcheck_disabled_returns_plain_primitives(monkeypatch):
+    monkeypatch.delenv("MX_RCNN_LOCK_CHECK", raising=False)
+    assert not isinstance(lockcheck.make_lock("X"), lockcheck.OrderedLock)
+    monkeypatch.setenv("MX_RCNN_LOCK_CHECK", "1")
+    assert isinstance(lockcheck.make_lock("X"), lockcheck.OrderedLock)
+
+
+def test_lockcheck_condition_proxy_wait_notify(monkeypatch):
+    monkeypatch.setenv("MX_RCNN_LOCK_CHECK", "1")
+    cond = lockcheck.make_condition("C")
+    hits = []
+
+    def waiter():
+        with cond:
+            hits.append(cond.wait(timeout=2.0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.time() + 2.0
+    while time.time() < deadline:
+        with cond:
+            cond.notify_all()
+        if hits:
+            break
+        time.sleep(0.01)
+    t.join(timeout=2.0)
+    assert hits == [True]
+
+
+# ------------------------------------------------- faults spec errors
+
+
+def test_unknown_fault_kind_is_hard_error(monkeypatch):
+    from mx_rcnn_tpu.utils import faults
+
+    monkeypatch.setenv("MX_RCNN_FAULTS", "predict_fial@0.1")
+    faults.reset()
+    with pytest.raises(ValueError, match="predict_fial"):
+        faults.predict_fault(0, 1)
+    monkeypatch.setenv("MX_RCNN_FAULTS", "")
+    faults.reset()
+
+
+def test_valid_fault_specs_still_parse(monkeypatch):
+    from mx_rcnn_tpu.utils import faults
+
+    monkeypatch.setenv(
+        "MX_RCNN_FAULTS", "nan_loss@3,predict_fail@0.1x2,swap_verify_fail@*"
+    )
+    faults.reset()
+    # wrong keys: parses fine, fires nothing
+    faults.corrupt_loss(0.5, None)
+    monkeypatch.setenv("MX_RCNN_FAULTS", "")
+    faults.reset()
+
+
+# ------------------------------------------------- bench artifacts
+
+
+def test_bench_artifacts_parse():
+    assert check_bench_artifacts(REPO) == []
+    found = sorted(p.name for p in REPO.glob("BENCH_*.json"))
+    assert found, "committed BENCH_*.json artifacts should exist"
+    for p in REPO.glob("BENCH_*.json"):
+        doc = json.loads(p.read_text())
+        assert isinstance(doc, (dict, list)) and doc

@@ -49,6 +49,19 @@ from mx_rcnn_tpu.serve.registry import (
 from mx_rcnn_tpu.serve.replica import HealthPolicy, Replica, ReplicaState
 from mx_rcnn_tpu.utils import faults
 
+
+@pytest.fixture(autouse=True)
+def _lock_order_check(monkeypatch):
+    """Run the whole fault matrix with the R4 runtime counterpart on:
+    every serve-stack lock becomes an order-asserting proxy
+    (analysis/lockcheck.py) that raises LockOrderViolation at the
+    acquire that would close a cycle."""
+    from mx_rcnn_tpu.analysis import lockcheck
+
+    monkeypatch.setenv("MX_RCNN_LOCK_CHECK", "1")
+    lockcheck.reset()
+    yield
+
 LADDER = ((32, 32), (48, 64))
 SIZES = ((24, 24), (32, 48), (16, 16))  # exercises both buckets
 

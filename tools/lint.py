@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""Repo-root graftlint entry point: ``python tools/lint.py`` (see
+ANALYSIS.md).  Keeps the analyzer importable without installing the
+package."""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from mx_rcnn_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
