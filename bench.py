@@ -996,6 +996,289 @@ def bench_pipeline(
     return _pipeline_records(report), report
 
 
+def _elastic_records(report: dict) -> list:
+    """Elastic chaos report → JSON-line records (pure; the bench schema
+    test builds a synthetic report and asserts the per-scenario
+    zero-lost/bit-identical/recovery fields without running the matrix)."""
+    def rec(metric, value, unit):
+        return {"metric": metric, "value": value, "unit": unit,
+                "vs_baseline": None}
+
+    recs = [
+        rec("elastic_devices", report["devices"], "replicas"),
+        rec("elastic_steps", report["steps"], "steps"),
+    ]
+    for name, s in report["scenarios"].items():
+        recs += [
+            rec(f"elastic_{name}_zero_lost_steps",
+                int(s["zero_lost_steps"]), "bool"),
+            rec(f"elastic_{name}_bit_identical",
+                int(s["bit_identical"]), "bool"),
+            rec(f"elastic_{name}_recovery_s", s["recovery_s"], "seconds"),
+            rec(f"elastic_{name}_final_replicas",
+                s["final_replicas"], "replicas"),
+        ]
+    return recs
+
+
+def bench_elastic(steps: int, batch_images: int) -> tuple:
+    """Chaos matrix for elastic training on 8 virtual CPU devices.
+
+    Four deterministic fault scenarios (``MX_RCNN_FAULTS`` device-phase
+    injectors keyed step×replica — no sleeps-and-hope) over the same
+    seeded batch stream and ONE pair of compiled executables (8-replica
+    and 7-replica mesh, warmed before timing so ``recovery_s`` measures
+    the drain/checkpoint/reshard path, as on a pod with a hot compile
+    cache):
+
+    - ``lose_1_of_8``: a replica dies mid-step and stays dead — the run
+      shrinks to 7 and completes; its final state is compared BITWISE to
+      a fresh 7-replica run restored from the emergency checkpoint and
+      fed the remaining stream (the shrink-equivalence bar).
+    - ``wedge``: a wedged (not dead) replica — same shrink mechanics;
+      final state must equal the lose case bitwise (the loop cannot tell
+      the difference, by design).
+    - ``lose_then_regrow``: the wedge heals; at the next checkpoint
+      boundary the mesh regrows to 8.  Run twice — recovery must be
+      bit-reproducible end to end.
+    - ``preempt_during_shrink``: the emergency save itself is killed
+      mid-write (``save_crash``); the restarted run resumes from the
+      last committed dump, hits the same fault, and must land on the
+      lose case's exact bytes (resumed stream identical).
+
+    Every scenario asserts zero lost steps beyond the pipeline window:
+    each stream index's aux is delivered exactly once.
+    """
+    import os
+    import tempfile
+
+    import jax
+
+    from mx_rcnn_tpu.core.checkpoint import (
+        is_committed,
+        load_restorable,
+        save_checkpoint,
+    )
+    from mx_rcnn_tpu.core.resilience import host_copy
+    from mx_rcnn_tpu.core.train import create_train_state, make_optimizer
+    from mx_rcnn_tpu.data.loader import TrainLoader
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.parallel.elastic import ElasticLoop, make_elastic_factory
+    from mx_rcnn_tpu.utils import faults
+    from mx_rcnn_tpu.utils.load_data import load_gt_roidb
+
+    base = 8
+    if len(jax.devices()) < base:
+        raise RuntimeError(
+            f"elastic bench needs {base} devices, got {len(jax.devices())}"
+        )
+    if batch_images % base:
+        raise ValueError("batch_images must divide by 8 replicas")
+    fault_step, victim, wedge_dur = 3, 2, 2
+    boundary_at = max(fault_step + wedge_dur + 1, steps - 2)
+    survivors = tuple(o for o in range(base) if o != victim)
+
+    cfg = _smoke_config(batch_images)
+    _, roidb = load_gt_roidb(
+        cfg, None, flip=False, synthetic_size=max(8, 2 * batch_images)
+    )
+    model = build_model(cfg)
+    h, w = cfg.SHAPE_BUCKETS[0]
+    params = model.init(
+        {"params": jax.random.key(0), "sampling": jax.random.key(1)},
+        images=np.zeros((1, h, w, 3), np.float32),
+        im_info=np.array([[h, w, 1.0]], np.float32),
+        gt_boxes=np.zeros((1, cfg.dataset.MAX_GT_BOXES, 5), np.float32),
+        gt_valid=np.zeros((1, cfg.dataset.MAX_GT_BOXES), bool),
+        train=True,
+    )["params"]
+    tx = make_optimizer(cfg, lambda s: cfg.TRAIN.LEARNING_RATE)
+    host_params = host_copy(params)
+
+    # the stream is precomputed so every scenario (and every fresh-mesh
+    # equivalence run) consumes literally the same host arrays
+    loader = TrainLoader(roidb, cfg, batch_images, shuffle=True, seed=0)
+    batches = []
+    while len(batches) < steps:
+        for b in loader:
+            batches.append(b)
+            if len(batches) >= steps:
+                break
+
+    # one context per active set, shared across scenarios: the 7-mesh
+    # executable compiles once, like a pod reusing its compile cache
+    base_factory = make_elastic_factory(model, tx, deterministic=True)
+    ctx_cache: dict = {}
+
+    def factory(active):
+        key = tuple(active)
+        if key not in ctx_cache:
+            ctx_cache[key] = base_factory(key)
+        return ctx_cache[key]
+
+    rng = jax.random.key(0)
+
+    def fresh_state():
+        # host_copy, not device_get: donated steps would corrupt a CPU
+        # zero-copy view of these buffers
+        return host_copy(create_train_state(host_params, tx))
+
+    def state_bytes(state):
+        return b"".join(
+            np.asarray(x).tobytes()
+            for x in jax.tree_util.tree_leaves(jax.device_get(state))
+        )
+
+    compile_s = {}
+    for act in (tuple(range(base)), survivors):
+        ctx = factory(act)
+        st = ctx.place_state(fresh_state())
+        t0 = time.perf_counter()
+        ctx.step_fn(st, ctx.place_batch(batches[0]), rng)
+        compile_s[len(act)] = round(time.perf_counter() - t0, 3)
+
+    def run(prefix, spec, *, resume=False, boundary=None, reset=True):
+        os.environ[faults.ENV_VAR] = spec
+        if reset:
+            faults.reset()
+
+        def ckpt_fn(host_state, idx, meta):
+            return save_checkpoint(prefix, host_state, 0, idx, meta=meta)
+
+        loop = ElasticLoop(factory, base, checkpoint_fn=ckpt_fn)
+        state = fresh_state()
+        start = 0
+        if resume:
+            got = load_restorable(prefix, state)
+            assert got is not None, "restart found nothing restorable"
+            (_epoch, start), state = got
+            assert start == 0, "bench restart resumes the epoch head"
+        state = loop.ctx.place_state(state)
+        delivered = []
+        t0 = time.perf_counter()
+        for i in range(start, steps):
+            state, ready, _ok = loop.step(state, batches[i], rng)
+            delivered += [idx for idx, _aux in ready]
+            if boundary is not None and i == boundary - 1:
+                state, ready, _ok = loop.flush(state)
+                delivered += [idx for idx, _aux in ready]
+                save_checkpoint(prefix, host_copy(state), 1, 0)
+                state, _regrown = loop.checkpoint_boundary(state)
+        state, ready, _ok = loop.flush(state)
+        delivered += [idx for idx, _aux in ready]
+        wall = time.perf_counter() - t0
+        return {
+            "loop": loop,
+            "bytes": state_bytes(state),
+            "delivered": delivered,
+            "wall_s": round(wall, 3),
+        }
+
+    def summarize(r, bit_identical):
+        loop = r["loop"]
+        uniq = set(r["delivered"])
+        return {
+            "final_replicas": len(loop.active),
+            "shrinks": loop.monitor.shrinks,
+            "regrows": loop.monitor.regrows,
+            "emergency_checkpoints": len(loop.emergency_ckpts),
+            "emergency_committed": all(
+                is_committed(p) for p in loop.emergency_ckpts
+            ),
+            "replayed_steps": loop.replayed_steps,
+            "lost_steps": steps - len(uniq),
+            "duplicate_deliveries": len(r["delivered"]) - len(uniq),
+            "zero_lost_steps": (
+                sorted(uniq) == list(range(steps))
+                and len(r["delivered"]) == steps
+            ),
+            "recovery_s": round(loop.recovery_s, 4),
+            "wall_s": r["wall_s"],
+            "bit_identical": bool(bit_identical),
+            "transitions": loop.monitor.transitions,
+        }
+
+    scenarios = {}
+
+    # -- lose 1 of 8, down forever ------------------------------------
+    with tempfile.TemporaryDirectory() as td:
+        r1 = run(td, f"device_lost@{fault_step}.{victim}")
+        # fresh-mesh equivalence: restore the EMERGENCY checkpoint, build
+        # a 7-replica substrate from scratch, feed the remaining stream
+        got = load_restorable(td, fresh_state())
+        assert got is not None, "emergency checkpoint not restorable"
+        (_e, anchor), anchor_state = got
+        ctx = factory(survivors)
+        st = ctx.place_state(anchor_state)
+        for i in range(anchor, steps):
+            st, _aux = ctx.step_fn(st, ctx.place_batch(batches[i]), rng)
+        scenarios["lose_1_of_8"] = summarize(
+            r1, state_bytes(st) == r1["bytes"]
+        )
+        scenarios["lose_1_of_8"]["emergency_anchor_step"] = anchor
+
+    # -- wedged replica (indistinguishable from lost, by design) ------
+    with tempfile.TemporaryDirectory() as td:
+        r2 = run(td, f"device_wedge@{fault_step}.{victim}:{steps}")
+        scenarios["wedge"] = summarize(r2, r2["bytes"] == r1["bytes"])
+
+    # -- wedge heals -> regrow at the checkpoint boundary; run twice ---
+    spec3 = f"device_wedge@{fault_step}.{victim}:{wedge_dur}"
+    with tempfile.TemporaryDirectory() as td:
+        r3a = run(td, spec3, boundary=boundary_at)
+    with tempfile.TemporaryDirectory() as td:
+        r3b = run(td, spec3, boundary=boundary_at)
+    scenarios["lose_then_regrow"] = summarize(
+        r3a, r3a["bytes"] == r3b["bytes"]
+    )
+
+    # -- the emergency save itself is killed mid-write ----------------
+    with tempfile.TemporaryDirectory() as td:
+        save_checkpoint(td, fresh_state(), 0, 0)  # last committed dump
+        spec4 = f"device_lost@{fault_step}.{victim},save_crash@1"
+        os.environ[faults.ENV_VAR] = spec4
+        faults.reset()
+        crashed = False
+        loop_x = ElasticLoop(
+            factory, base,
+            checkpoint_fn=lambda s, i, m: save_checkpoint(
+                td, s, 0, i, meta=m
+            ),
+        )
+        state = loop_x.ctx.place_state(fresh_state())
+        try:
+            for i in range(steps):
+                state, _ready, _ok = loop_x.step(state, batches[i], rng)
+        except faults.SimulatedCrash:
+            crashed = True
+        orphan = any(d.endswith(".tmp") for d in os.listdir(td))
+        # restart in the same fault registry: save_crash@1 is consumed,
+        # the device fault is still live — the resumed run re-hits it,
+        # shrinks cleanly, and must land on the lose case's exact bytes
+        r4 = run(td, spec4, resume=True, reset=False)
+        s4 = summarize(r4, r4["bytes"] == r1["bytes"])
+        s4["crashed_mid_shrink"] = crashed
+        s4["orphan_tmp_left"] = orphan
+        scenarios["preempt_during_shrink"] = s4
+
+    os.environ.pop(faults.ENV_VAR, None)
+    faults.reset()
+
+    report = {
+        "devices": base,
+        "steps": steps,
+        "batch_images": batch_images,
+        "fault_step": fault_step,
+        "victim": victim,
+        "wedge_duration": wedge_dur,
+        "boundary_at": boundary_at,
+        "pipeline_window": 1,
+        "compile_s": compile_s,
+        "scenarios": scenarios,
+    }
+    return _elastic_records(report), report
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -1070,6 +1353,16 @@ def main():
                     help="device-feed double-buffer depth")
     ap.add_argument("--pipeline_batch", type=int, default=2)
     ap.add_argument(
+        "--elastic", action="store_true",
+        help="chaos matrix for elastic training on 8 virtual CPU devices "
+             "(lose-1-of-8 / wedge / lose-then-regrow / preempt-during-"
+             "shrink; zero-lost + bitwise shrink-equivalence + recovery "
+             "seconds)",
+    )
+    ap.add_argument("--elastic_steps", type=int, default=8)
+    ap.add_argument("--elastic_batch", type=int, default=8,
+                    help="global batch for --elastic (must divide by 8)")
+    ap.add_argument(
         "--out", default=None,
         help="also write the records as a JSON array artifact",
     )
@@ -1077,7 +1370,25 @@ def main():
 
     from mx_rcnn_tpu.utils.platform import enable_compile_cache
 
+    if args.elastic:
+        # env-only, and BEFORE enable_compile_cache touches jax: the 8
+        # virtual devices must exist at backend init, and the compile
+        # cache subdir is keyed on the XLA_FLAGS this sets
+        from mx_rcnn_tpu.utils.platform import set_cpu_platform
+
+        set_cpu_platform(8)
+
     enable_compile_cache()
+
+    if args.elastic:
+        records, report = bench_elastic(args.elastic_steps,
+                                        args.elastic_batch)
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
 
     if args.eval_plane:
         from mx_rcnn_tpu.tools.bench_eval import data_plane_report

@@ -16,6 +16,36 @@ from typing import List, Optional, Sequence
 from mx_rcnn_tpu.analysis import engine as eng
 
 
+# per-artifact required report shape: {filename: (report_keys, scenario
+# names that must each carry the per-scenario keys)}.  Catches a bench
+# refactor silently committing an artifact that no longer proves what
+# the Makefile target's comment says it proves.
+_ELASTIC_SCENARIOS = (
+    "lose_1_of_8", "wedge", "lose_then_regrow", "preempt_during_shrink",
+)
+_ELASTIC_SCENARIO_KEYS = ("recovery_s", "zero_lost_steps", "bit_identical")
+
+
+def _check_elastic_schema(name: str, doc: dict) -> List[str]:
+    errors = []
+    report = doc.get("report") if isinstance(doc, dict) else None
+    if not isinstance(report, dict):
+        return [f"bench artifact {name}: missing report object"]
+    scenarios = report.get("scenarios")
+    if not isinstance(scenarios, dict):
+        return [f"bench artifact {name}: report.scenarios missing"]
+    for s in _ELASTIC_SCENARIOS:
+        if s not in scenarios:
+            errors.append(f"bench artifact {name}: scenario '{s}' missing")
+            continue
+        for k in _ELASTIC_SCENARIO_KEYS:
+            if k not in scenarios[s]:
+                errors.append(
+                    f"bench artifact {name}: scenario '{s}' missing '{k}'"
+                )
+    return errors
+
+
 def check_bench_artifacts(root: Path) -> List[str]:
     errors = []
     for f in sorted(root.glob("BENCH_*.json")):
@@ -26,6 +56,9 @@ def check_bench_artifacts(root: Path) -> List[str]:
             continue
         if not isinstance(doc, (dict, list)) or not doc:
             errors.append(f"bench artifact {f.name}: empty or non-object")
+            continue
+        if f.name == "BENCH_elastic_cpu.json":
+            errors += _check_elastic_schema(f.name, doc)
     return errors
 
 

@@ -260,6 +260,7 @@ def default_rules() -> List[Rule]:
     from mx_rcnn_tpu.analysis.rules_locks import LockOrder
     from mx_rcnn_tpu.analysis.rules_futures import ExactlyOnce
     from mx_rcnn_tpu.analysis.rules_faults import FaultCoverage
+    from mx_rcnn_tpu.analysis.rules_signals import SignalSafety
 
     return [
         HostCopyEscape(),
@@ -268,6 +269,7 @@ def default_rules() -> List[Rule]:
         LockOrder(),
         ExactlyOnce(),
         FaultCoverage(),
+        SignalSafety(),
     ]
 
 

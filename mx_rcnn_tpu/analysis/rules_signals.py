@@ -1,0 +1,223 @@
+"""R7 signal-safety: what a registered signal handler may touch.
+
+A Python signal handler runs on the main thread at an arbitrary bytecode
+boundary — possibly in the middle of a jit dispatch, while a serve lock
+is held, or inside the fault registry's parse.  The project's contract
+(``PreemptionGuard``) is that handlers only flip flags and re-raise:
+anything heavier belongs after the step loop polls the flag.
+
+This rule finds every handler registered via ``signal.signal(SIG, h)``
+and walks its body — plus same-class ``self.*`` methods and same-module
+functions it calls, to a fixed point — flagging:
+
+* **device work**: ``jax.device_put/device_get/jit/pmap`` or the
+  project placement helpers (``host_copy``, ``replicate``,
+  ``shard_batch``, ``make_place_fn``) — a handler interrupting the very
+  dispatch it re-enters can deadlock the runtime;
+* **lock acquisition**: ``with <lock-ish attribute>:`` or an explicit
+  ``.acquire()`` — the interrupted frame may already hold that lock
+  (classic async-signal deadlock);
+* **fault-injection hooks**: any ``faults.*`` call — the registry
+  re-parses on env change and mutates shared trigger counters, neither
+  of which is reentrant.
+
+``PreemptionGuard._handle`` (flag flip, handler restore, ``os.kill``
+re-raise) is the canonical clean fixture and must produce no findings.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from mx_rcnn_tpu.analysis.engine import Finding, Module, Rule, dotted
+
+# dotted names (exact) that are device/compile work
+DEVICE_EXACT = {"jax.device_put", "jax.device_get", "jax.jit", "jax.pmap"}
+# last-component names that are device/placement work wherever they live
+DEVICE_TAILS = {
+    "device_put", "device_get", "host_copy", "replicate", "shard_batch",
+    "make_place_fn",
+}
+# attribute names that look like locks when used as ``with self.<attr>:``
+_LOCKISH = ("lock", "mutex", "cond", "cv")
+
+
+def _lockish_attr(name: str) -> bool:
+    low = name.lower()
+    return any(t in low for t in _LOCKISH)
+
+
+class _Handler:
+    """One registered handler: the function node plus where it was
+    registered (for the finding's anchor when the body lives elsewhere)."""
+
+    def __init__(
+        self,
+        module: Module,
+        fn: ast.FunctionDef,
+        cls: Optional[ast.ClassDef],
+        reg_line: int,
+    ):
+        self.module = module
+        self.fn = fn
+        self.cls = cls
+        self.reg_line = reg_line
+
+
+class SignalSafety(Rule):
+    id = "R7"
+    name = "signal safety"
+
+    def finalize(self, modules: Sequence[Module]) -> List[Finding]:
+        out: List[Finding] = []
+        for m in modules:
+            for h in self._handlers(m):
+                out.extend(self._check_handler(h))
+        return out
+
+    # ---- registration discovery ------------------------------------
+
+    def _handlers(self, m: Module) -> List[_Handler]:
+        found: List[_Handler] = []
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.Call) and len(node.args) >= 2):
+                continue
+            if dotted(node.func) != "signal.signal":
+                continue
+            target = node.args[1]
+            fn, cls = self._resolve_handler(m, node, target)
+            if fn is not None:
+                found.append(_Handler(m, fn, cls, node.lineno))
+        return found
+
+    def _resolve_handler(
+        self, m: Module, site: ast.Call, target: ast.AST
+    ) -> Tuple[Optional[ast.FunctionDef], Optional[ast.ClassDef]]:
+        # self._handle → method of the class enclosing the registration
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            cls = self._enclosing_class(m, site)
+            if cls is not None:
+                fn = self._class_method(cls, target.attr)
+                if fn is not None:
+                    return fn, cls
+            return None, None
+        # bare name → module-level function (or a local def in scope)
+        if isinstance(target, ast.Name):
+            fn = self._module_function(m, target.id)
+            if fn is not None:
+                return fn, None
+        return None, None
+
+    @staticmethod
+    def _enclosing_class(m: Module, node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = m.parent(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = m.parent(cur)
+        return None
+
+    @staticmethod
+    def _class_method(cls: ast.ClassDef, name: str) -> Optional[ast.FunctionDef]:
+        for child in cls.body:
+            if isinstance(child, ast.FunctionDef) and child.name == name:
+                return child
+        return None
+
+    @staticmethod
+    def _module_function(m: Module, name: str) -> Optional[ast.FunctionDef]:
+        for child in m.tree.body:
+            if isinstance(child, ast.FunctionDef) and child.name == name:
+                return child
+        return None
+
+    # ---- reachability + checks -------------------------------------
+
+    def _check_handler(self, h: _Handler) -> List[Finding]:
+        out: List[Finding] = []
+        visited: Set[int] = set()
+        queue: List[Tuple[ast.FunctionDef, Optional[ast.ClassDef]]] = [
+            (h.fn, h.cls)
+        ]
+        while queue:
+            fn, cls = queue.pop()
+            if id(fn) in visited:
+                continue
+            visited.add(id(fn))
+            scope = h.module.scope_of(fn)
+            for n in ast.walk(fn):
+                if isinstance(n, ast.With):
+                    for item in n.items:
+                        ctx = item.context_expr
+                        attr = (
+                            ctx.attr if isinstance(ctx, ast.Attribute)
+                            else ctx.id if isinstance(ctx, ast.Name)
+                            else None
+                        )
+                        if attr is not None and _lockish_attr(attr):
+                            out.append(self._finding(
+                                h, n.lineno, scope,
+                                f"acquires lock `{attr}` — the interrupted "
+                                f"frame may already hold it",
+                            ))
+                if not isinstance(n, ast.Call):
+                    continue
+                d = dotted(n.func) or ""
+                tail = d.rsplit(".", 1)[-1]
+                if d in DEVICE_EXACT or tail in DEVICE_TAILS:
+                    out.append(self._finding(
+                        h, n.lineno, scope,
+                        f"device/placement work `{d}` — a handler can "
+                        f"interrupt the dispatch it re-enters",
+                    ))
+                elif tail == "acquire" and isinstance(n.func, ast.Attribute):
+                    out.append(self._finding(
+                        h, n.lineno, scope,
+                        "explicit `.acquire()` — the interrupted frame may "
+                        "already hold the lock",
+                    ))
+                elif d.startswith("faults.") or d.startswith(
+                    "mx_rcnn_tpu.utils.faults."
+                ):
+                    out.append(self._finding(
+                        h, n.lineno, scope,
+                        f"fault-injection hook `{d}` — the registry's "
+                        f"parse/trigger state is not reentrant",
+                    ))
+                else:
+                    # follow same-class and same-module callees
+                    nxt = self._callee(h, cls, n)
+                    if nxt is not None:
+                        queue.append(nxt)
+        return out
+
+    def _callee(
+        self, h: _Handler, cls: Optional[ast.ClassDef], call: ast.Call
+    ) -> Optional[Tuple[ast.FunctionDef, Optional[ast.ClassDef]]]:
+        f = call.func
+        if (
+            cls is not None
+            and isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+        ):
+            fn = self._class_method(cls, f.attr)
+            if fn is not None:
+                return fn, cls
+        if isinstance(f, ast.Name):
+            fn = self._module_function(h.module, f.id)
+            if fn is not None:
+                return fn, None
+        return None
+
+    def _finding(self, h: _Handler, line: int, scope: str, msg: str) -> Finding:
+        return Finding(
+            self.id, h.module.path, line, scope,
+            f"reachable from signal handler `{h.module.scope_of(h.fn)}` "
+            f"(registered at line {h.reg_line}): {msg}",
+        )

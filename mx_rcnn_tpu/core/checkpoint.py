@@ -105,12 +105,21 @@ def _manifest_files(root: str) -> Dict[str, int]:
 
 
 def save_checkpoint(
-    prefix: str, state: TrainState, epoch: int, batch_in_epoch: int = 0
+    prefix: str,
+    state: TrainState,
+    epoch: int,
+    batch_in_epoch: int = 0,
+    meta: Optional[Dict[str, Any]] = None,
 ) -> str:
     """Crash-safe save: write ``<name>.tmp``, fsync a manifest into it,
     atomically rename to ``<name>``.  A kill at ANY point leaves either
     the previous committed dump intact or an orphaned ``.tmp`` that
-    every reader skips (and ``prune_step_checkpoints`` removes)."""
+    every reader skips (and ``prune_step_checkpoints`` removes).
+
+    ``meta`` (optional, JSON-serializable) is recorded verbatim under
+    ``manifest["meta"]`` — the elastic shrink path stamps its emergency
+    dumps with the fault kind, lost ordinals, and survivor set so a
+    post-mortem can reconstruct the membership history from disk."""
     import shutil
 
     final = os.path.abspath(
@@ -131,6 +140,8 @@ def save_checkpoint(
         "checksum": tree_checksum(host_state),
         "files": _manifest_files(tmp),
     }
+    if meta is not None:
+        manifest["meta"] = meta
     # injection point: a SIGKILL between the data write and the commit
     faults.crash_save()
     mpath = os.path.join(tmp, MANIFEST)
@@ -300,15 +311,33 @@ def load_restorable(
 
 
 def prune_step_checkpoints(prefix: str, up_to_epoch: int) -> None:
-    """Delete ``step_E_B`` preemption dumps with E ≤ ``up_to_epoch`` —
-    they are superseded once ``epoch_{E+1}`` exists — plus ANY orphaned
-    ``.tmp`` dir (an interrupted save that will never be committed).
-    Without pruning, a long run on a preemptible pool accumulates one
-    full params+momentum dump per preemption."""
+    """Delete ``step_E_B`` preemption/emergency dumps with E ≤
+    ``up_to_epoch`` — they are superseded once ``epoch_{E+1}`` exists —
+    plus ANY orphaned ``.tmp`` dir (an interrupted save that will never
+    be committed).  Without pruning, a long run on a preemptible pool
+    accumulates one full params+momentum dump per preemption.
+
+    Retain guard: the NEWEST COMMITTED step dump is never deleted, even
+    when its epoch is ≤ ``up_to_epoch``.  The boundary save that
+    supersedes it can itself be lost or corrupt (the scenario
+    ``load_restorable`` falls back past), and pruning must not shorten
+    that fallback chain below one verifiable mid-epoch dump.  Committed
+    is the :func:`is_committed` bar, so a corrupt dump that happens to
+    sort newest does not shadow the real survivor — the guard keys on
+    the newest dump a resume could actually use."""
     import shutil
 
     if not os.path.isdir(prefix):
         return
+    step_dumps = []
+    for d in os.listdir(prefix):
+        parsed = _parse_ckpt_name(d)
+        if parsed is not None and parsed[1] != 0:
+            step_dumps.append((parsed, d))
+    keep = max(
+        (pd for pd in step_dumps if is_committed(os.path.join(prefix, pd[1]))),
+        default=None,
+    )
     for d in os.listdir(prefix):
         full = os.path.join(prefix, d)
         if d.endswith(".tmp") and os.path.isdir(full):
@@ -317,6 +346,8 @@ def prune_step_checkpoints(prefix: str, up_to_epoch: int) -> None:
             continue
         parsed = _parse_ckpt_name(d)
         if parsed is None or parsed[1] == 0:
+            continue
+        if keep is not None and d == keep[1]:
             continue
         if parsed[0] <= up_to_epoch:
             shutil.rmtree(full, ignore_errors=True)

@@ -9,6 +9,7 @@ own richer loop (resume, DP mesh) in ``tools/train_end2end.py``.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 from typing import Dict, List, Optional
 
@@ -52,6 +53,23 @@ def merge_params(init_params: Dict, donor: Dict) -> Dict:
     return out
 
 
+def batch_digest(batch: Dict[str, np.ndarray]) -> str:
+    """Order-stable sha256 over a host batch's keys + array bytes.
+
+    One digest line per consumed batch is the cheap observable the
+    preemption/resume integration test compares: a preempted-then-resumed
+    run is correct iff its concatenated digest stream equals an
+    uninterrupted run's — bit-identical data, in order, no gaps, no
+    repeats."""
+    h = hashlib.sha256()
+    for k in sorted(batch):
+        arr = np.asarray(batch[k])
+        h.update(k.encode())
+        h.update(f"{arr.dtype}{arr.shape}".encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
 def fit(
     model,
     cfg: Config,
@@ -68,6 +86,9 @@ def fit(
     step_timeout: float = 0.0,
     aux_interval: int = 1,
     feed_depth: int = 2,
+    prefix: Optional[str] = None,
+    resume: bool = False,
+    stream_log: Optional[str] = None,
 ) -> Dict:
     """Train ``model`` on ``roidb`` and return the final params.
 
@@ -88,6 +109,16 @@ def fit(
     step donates its input state.  ``aux_interval`` > 1 defers the aux
     fetch K steps (flushed at epoch end); the default 1 keeps the
     per-step check byte-identical to the synchronous loop.
+
+    ``prefix`` enables checkpointing (epoch-boundary saves + prune) and
+    installs a :class:`~mx_rcnn_tpu.core.checkpoint.PreemptionGuard`:
+    SIGTERM/SIGINT flushes the pipeline, writes a committed mid-epoch
+    ``step_E_B`` dump, and returns early.  ``resume=True`` restores the
+    newest restorable checkpoint under ``prefix`` and continues the
+    exact batch stream (the loader's deterministic per-(seed, epoch)
+    plan plus ``skip_batches``).  ``stream_log`` appends one
+    ``epoch batch digest`` line per consumed batch — the observable the
+    resume integration test compares bit-for-bit.
     """
     loader = TrainLoader(
         roidb, cfg, cfg.TRAIN.BATCH_IMAGES,
@@ -117,6 +148,19 @@ def fit(
         cfg, make_lr_schedule(cfg, steps_per_epoch), fixed_params=fixed_params
     )
     state = create_train_state(params, tx)
+
+    begin_epoch, begin_batch = 0, 0
+    if prefix and resume:
+        from mx_rcnn_tpu.core.checkpoint import load_restorable
+
+        got = load_restorable(prefix, state)
+        if got is not None:
+            (begin_epoch, begin_batch), state = got
+            logger.info(
+                "fit: resuming from epoch %d batch %d", begin_epoch,
+                begin_batch,
+            )
+
     # donation unified with the end2end/mesh entry points: rollback
     # re-places from the guard's host snapshot, never a donated buffer
     step_fn = make_train_step(model, tx, donate=True)
@@ -134,23 +178,65 @@ def fit(
         for _idx, aux in ready:
             tracker.update({k: float(v) for k, v in aux.items()})
 
+    guard = None
+    log_f = open(stream_log, "a") if stream_log else None
+    if prefix:
+        from mx_rcnn_tpu.core.checkpoint import (
+            PreemptionGuard,
+            prune_step_checkpoints,
+            save_checkpoint,
+        )
+
+        guard = PreemptionGuard()
+    loader.epoch = begin_epoch
+    loader.skip_batches = begin_batch
+
     total_steps = 0
-    for epoch in range(epochs):
-        feed = DeviceFeed(iter(loader), depth=feed_depth)
-        try:
-            for batch in feed:
-                state, ready, _ok = pipeline.step(state, batch, rng)
-                deliver(ready)
-                total_steps += 1
-                speedo(epoch, total_steps, tracker)
-                if max_steps and total_steps >= max_steps:
-                    break
-        finally:
-            feed.close()
-        state, ready, _ok = pipeline.flush(state)
-        deliver(ready)
-        if max_steps and total_steps >= max_steps:
-            break
+    preempted = False
+    try:
+        for epoch in range(begin_epoch, epochs):
+            # position within the epoch's deterministic plan (resume skips
+            # the first skip_batches entries, so enumeration is offset)
+            pos = begin_batch if epoch == begin_epoch else 0
+            feed = DeviceFeed(iter(loader), depth=feed_depth)
+            try:
+                for batch in feed:
+                    if log_f is not None:
+                        line = f"{epoch} {pos} {batch_digest(batch)}\n"
+                        log_f.write(line)
+                        log_f.flush()
+                    state, ready, _ok = pipeline.step(state, batch, rng)
+                    deliver(ready)
+                    total_steps += 1
+                    pos += 1
+                    speedo(epoch, total_steps, tracker)
+                    if guard is not None and guard.should_stop:
+                        preempted = True
+                        break
+                    if max_steps and total_steps >= max_steps:
+                        break
+            finally:
+                feed.close()
+            state, ready, _ok = pipeline.flush(state)
+            deliver(ready)
+            if preempted:
+                if pos > 0:
+                    save_checkpoint(prefix, state, epoch, pos)
+                    logger.warning(
+                        "fit: preempted — saved step checkpoint at epoch "
+                        "%d batch %d", epoch, pos,
+                    )
+                break
+            if max_steps and total_steps >= max_steps:
+                break
+            if prefix:
+                save_checkpoint(prefix, state, epoch + 1)
+                prune_step_checkpoints(prefix, epoch)
+    finally:
+        if guard is not None:
+            guard.uninstall()
+        if log_f is not None:
+            log_f.close()
     last_loss = pipeline.last_loss if total_steps else float("nan")
     logger.info("fit done: %d steps, last loss %.4f", total_steps, last_loss)
     if pipeline.skipped_batches:

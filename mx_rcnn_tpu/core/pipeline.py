@@ -59,6 +59,7 @@ from mx_rcnn_tpu.core.resilience import (
     DivergencePolicy,
     GuardedLoop,
     StepWatchdog,
+    _supports_lr_scale,
     host_copy,
 )
 from mx_rcnn_tpu.utils import faults
@@ -442,6 +443,45 @@ class PipelinedLoop:
         if self.aux_interval > 1:
             return len(self._entries)
         return self.guard.steps_since_snapshot
+
+    @property
+    def pending(self) -> int:
+        """Dispatched-but-unverified steps in the current window."""
+        return len(self._entries)
+
+    @property
+    def next_index(self) -> int:
+        """Stream index the next ``step`` call will dispatch at."""
+        return self._idx if self.aux_interval > 1 else self.guard.step_index
+
+    # -- elastic mesh-swap surface (parallel/elastic.py)
+    def rebind(self, step_fn: Callable,
+               place_fn: Optional[Callable[[Any], Any]] = None) -> None:
+        """Swap the step/placement functions in place — the elastic loop
+        rebuilds both against a shrunken or regrown mesh and the loop
+        (and its guard's retry path) must dispatch through the new ones.
+        Counters, divergence EMA, and budgets deliberately survive: the
+        run continues, only the execution substrate changed."""
+        self._step_fn = step_fn
+        self.guard._step_fn = step_fn
+        self.guard._lr_scale_ok = _supports_lr_scale(step_fn)
+        if place_fn is not None:
+            self._place = place_fn
+            self.guard._place = place_fn
+
+    def rewind(self, idx: int) -> None:
+        """Drop every in-flight (unverified) window entry and reset the
+        stream coordinate to ``idx``.  Used after a device fault: the
+        window's device aux handles belong to the broken mesh and must
+        never be fetched; the elastic loop re-places state from ITS host
+        anchor snapshot and re-dispatches the window's batches through
+        the rebound step, so the coordinates line up again."""
+        self._entries = []
+        self._win_snapshot = None
+        self._idx = idx
+        self.guard.step_index = idx
+        self.guard._snapshot = None
+        self.guard._since_snapshot = 0
 
     # -- step execution
     def _dispatch(self, state, batch, rng, tag: str):

@@ -45,6 +45,13 @@ logger = logging.getLogger(__name__)
 # with --resume.  Distinct from timeout(1)'s 124 and the test harness's 70.
 WATCHDOG_EXIT_CODE = 75
 
+# exit status of a run that COMPLETED but on a shrunken mesh (elastic
+# degraded-continue: a replica was lost mid-run and never regrew).  The
+# work finished — checkpoints are valid — but throughput and the
+# effective global batch were reduced, so a supervisor may want to
+# reschedule at full size.  Distinct from 75 ("restart me") and 0.
+DEGRADED_EXIT_CODE = 76
+
 
 class TrainingDiverged(RuntimeError):
     """Raised when the bad-batch budget is exhausted: the run is not

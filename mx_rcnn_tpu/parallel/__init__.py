@@ -1,7 +1,17 @@
 from mx_rcnn_tpu.parallel import distributed
+from mx_rcnn_tpu.parallel.elastic import (
+    ElasticContext,
+    ElasticLoop,
+    MeshMonitor,
+    NoSurvivorsError,
+    RegrowPolicy,
+    make_elastic_factory,
+)
 from mx_rcnn_tpu.parallel.mesh import (
     make_mesh,
     make_parallel_train_step,
+    replica_slices,
     replicate,
     shard_batch,
+    take_replica_rows,
 )

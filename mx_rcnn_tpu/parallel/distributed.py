@@ -105,6 +105,30 @@ def globalize_batch(
     }
 
 
+def agree_on_down(down, n_replicas: int) -> frozenset:
+    """Cross-process UNION of locally-suspected dead replica ordinals.
+
+    A device fault is observed by the process hosting the replica (or by
+    whoever's collective timed out first); every process must shrink to
+    the IDENTICAL survivor set or the rebuilt meshes disagree and the
+    next collective deadlocks — the same reasoning as
+    ``train_end2end.py``'s preemption stop vote, but for membership.
+    Single-process (the CPU chaos matrix) this is the identity; multi-
+    host it is one blocking allgather of an ``n_replicas``-bit mask,
+    paid only on the shrink path.
+    """
+    down = frozenset(int(d) for d in down)
+    if jax.process_count() == 1:
+        return down
+    from jax.experimental import multihost_utils
+
+    mask = np.zeros((n_replicas,), np.int32)
+    for d in down:
+        mask[d] = 1
+    votes = np.asarray(multihost_utils.process_allgather(mask))
+    return frozenset(int(i) for i in np.nonzero(votes.any(axis=0))[0])
+
+
 def local_global_batch_sizes(per_chip: int) -> tuple[int, int]:
     """(local, global) batch sizes for ``per_chip`` images per device."""
     return (

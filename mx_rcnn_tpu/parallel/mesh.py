@@ -75,8 +75,36 @@ def shard_batch(batch: Dict[str, np.ndarray], mesh: Mesh):
     return jax.device_put(batch, sharding)
 
 
+def take_replica_rows(batch: Dict, n_active: int, n_base: int) -> Dict:
+    """Truncate a base-mesh global batch to ``n_active`` of ``n_base``
+    replicas' worth of leading-axis rows.
+
+    The elastic shrink path (``parallel/elastic.py``) keeps the data
+    loader's plan at the BASE global batch size — re-planning mid-epoch
+    would invalidate the deterministic shuffle/bucketing stream — and
+    instead drops the tail rows of each global batch.  Always the tail,
+    never the dead replica's slice: the kept prefix is then a pure
+    function of the survivor COUNT, so a fresh small-mesh run fed the
+    same stream consumes bit-identical batches regardless of which
+    ordinal died.
+    """
+    if n_active == n_base:
+        return batch
+    out = {}
+    for k, v in batch.items():
+        rows = np.shape(v)[0]
+        if rows % n_base:
+            raise ValueError(
+                f"batch key {k!r}: {rows} rows not divisible by the "
+                f"{n_base}-replica base mesh"
+            )
+        out[k] = v[: rows * n_active // n_base]
+    return out
+
+
 def make_parallel_train_step(
-    model, tx, mesh: Mesh, accum_steps: int = 1, donate: bool = True
+    model, tx, mesh: Mesh, accum_steps: int = 1, donate: bool = True,
+    deterministic: bool = False,
 ):
     """The DP train step: per-chip compute + pmean on grads/metrics.
 
@@ -87,7 +115,10 @@ def make_parallel_train_step(
     many microbatches before its gradient joins the all-reduce).
     ``donate`` mirrors ``make_train_step``'s knob (same default: the
     input state is donated; rollback paths re-place from host
-    snapshots, never reuse a donated buffer).
+    snapshots, never reuse a donated buffer).  ``deterministic`` mirrors
+    it too: on CPU it pins the legacy run-order-stable XLA runtime so
+    two runs over identical inputs compare BITWISE — required by the
+    elastic chaos bench's shrink-equivalence check.
     """
     inner = make_train_step(model, tx, pmean_axis="data", accum_steps=accum_steps)
 
@@ -113,7 +144,15 @@ def make_parallel_train_step(
             rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
         return inner(state, batch, rng, lr_scale)
 
-    jitted = jax.jit(sharded_step, donate_argnums=(0,) if donate else ())
+    jit_kwargs: Dict[str, object] = {
+        "donate_argnums": (0,) if donate else ()
+    }
+    # same rationale as make_train_step: the default CPU thunk runtime
+    # reassociates reductions across threads, so even one executable on
+    # identical inputs drifts ~1e-7 run-to-run
+    if deterministic and jax.default_backend() == "cpu":
+        jit_kwargs["compiler_options"] = {"xla_cpu_use_thunk_runtime": False}
+    jitted = jax.jit(sharded_step, **jit_kwargs)
 
     def step(state: TrainState, batch, rng, lr_scale=1.0):
         # lr_scale: one-step effective-LR override (replicated scalar) —

@@ -31,6 +31,7 @@ from mx_rcnn_tpu.core.checkpoint import (
 from mx_rcnn_tpu.core.metrics import MetricTracker, Speedometer
 from mx_rcnn_tpu.core.pipeline import DeviceFeed, PipelinedLoop, make_place_fn
 from mx_rcnn_tpu.core.resilience import (
+    DEGRADED_EXIT_CODE,
     DivergencePolicy,
     StepWatchdog,
 )
@@ -43,7 +44,9 @@ from mx_rcnn_tpu.core.train import (
 from mx_rcnn_tpu.data.loader import TrainLoader
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.parallel import (
+    ElasticLoop,
     distributed,
+    make_elastic_factory,
     make_mesh,
     make_parallel_train_step,
     replicate,
@@ -84,6 +87,13 @@ def parse_args(argv=None):
                    help="stop after N steps (smoke runs)")
     p.add_argument("--cpu", type=int, default=0, metavar="N",
                    help="force the host backend with N virtual devices")
+    p.add_argument("--elastic", action="store_true",
+                   help="survive device loss: on a device fault, take an "
+                        "emergency checkpoint, deterministically shrink "
+                        "the data mesh to the survivors, replay the "
+                        "in-flight window, and keep training (regrow is "
+                        "attempted at checkpoint boundaries); a run that "
+                        "finishes shrunken exits 76")
     p.add_argument("--dist_coordinator", default=None, metavar="HOST:PORT",
                    help="multi-host training: process 0's coordinator "
                         "address (jax.distributed); on TPU pods usually "
@@ -130,7 +140,7 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def train_net(args):
+def train_net(args, report=None):
     import dataclasses
 
     from mx_rcnn_tpu.utils.platform import cli_bootstrap
@@ -280,7 +290,10 @@ def train_net(args):
             logger.info("resumed from epoch %d batch %d", epoch, begin_batch)
 
     use_mesh = n_chips > 1
-    if use_mesh:
+    use_elastic = args.elastic and use_mesh
+    if use_elastic:
+        step_fn = None  # the elastic loop owns (and rebuilds) the step
+    elif use_mesh:
         mesh = make_mesh(n_data=n_chips, n_model=1)
         state = replicate(state, mesh)
         step_fn = make_parallel_train_step(
@@ -302,21 +315,55 @@ def train_net(args):
     aux_interval = args.aux_interval or (
         1 if jax.default_backend() == "cpu" else 8
     )
-    pipeline = PipelinedLoop(
-        step_fn,
-        policy=DivergencePolicy(
-            spike_factor=args.spike_factor,
-            max_bad_batches=args.max_bad_batches,
-        ),
-        snapshot_every=args.snapshot_every,
-        place_fn=(lambda t: replicate(t, mesh)) if use_mesh else None,
-        aux_interval=aux_interval,
+    guard_policy = DivergencePolicy(
+        spike_factor=args.spike_factor,
+        max_bad_batches=args.max_bad_batches,
     )
-    # one placement path for every topology: single chip, DP mesh
-    # (shard_batch), multi-host (globalize_batch) — run by the feed's
-    # worker thread so batch N+1's transfer overlaps step N
-    batch_place = make_place_fn(mesh if use_mesh else None)
     loop_pos = {"epoch": begin_epoch, "batch": begin_batch}
+    eloop = None
+    if use_elastic:
+        # stream-step → (epoch, batch) translation for emergency dumps:
+        # refreshed at each epoch start
+        epoch_pos = {"start_step": 0, "off": begin_batch}
+
+        def _emergency_ckpt(host_state, stream_step, meta):
+            if jax.process_index() != 0:
+                return None
+            bpos = max(
+                0, stream_step - epoch_pos["start_step"] + epoch_pos["off"]
+            )
+            return save_checkpoint(
+                args.prefix, host_state, loop_pos["epoch"], bpos, meta=meta
+            )
+
+        eloop = ElasticLoop(
+            make_elastic_factory(model, tx, accum_steps=args.grad_accum),
+            n_chips,
+            policy=guard_policy,
+            aux_interval=aux_interval,
+            checkpoint_fn=_emergency_ckpt,
+        )
+        # state placement is the elastic context's job (and is redone on
+        # every membership change)
+        state = eloop.ctx.place_state(jax.device_get(state))
+        pipeline = eloop.pipe  # shared watchdog/stats surface
+        # the elastic loop needs HOST batches — it truncates to the
+        # survivor count and shards to the CURRENT mesh itself
+        batch_place = lambda b: b  # noqa: E731
+        step_loop = eloop
+    else:
+        pipeline = PipelinedLoop(
+            step_fn,
+            policy=guard_policy,
+            snapshot_every=args.snapshot_every,
+            place_fn=(lambda t: replicate(t, mesh)) if use_mesh else None,
+            aux_interval=aux_interval,
+        )
+        # one placement path for every topology: single chip, DP mesh
+        # (shard_batch), multi-host (globalize_batch) — run by the feed's
+        # worker thread so batch N+1's transfer overlaps step N
+        batch_place = make_place_fn(mesh if use_mesh else None)
+        step_loop = pipeline
     if args.step_timeout > 0:
         def _watchdog_dump():
             snap = pipeline.last_snapshot
@@ -375,13 +422,16 @@ def train_net(args):
         # force the deferred aux checks before any checkpoint/summary:
         # a divergence inside the window must roll back NOW, not after
         # the bad state has been persisted
-        state, ready, _ok = pipeline.flush(state)
+        state, ready, _ok = step_loop.flush(state)
         deliver(ready)
         return state
 
     try:
         for epoch in range(begin_epoch, args.epochs):
             batch_in_epoch = begin_batch if epoch == begin_epoch else 0
+            if use_elastic:
+                epoch_pos["start_step"] = eloop.pipe.next_index
+                epoch_pos["off"] = batch_in_epoch
             feed = DeviceFeed(
                 iter(loader), place_fn=batch_place, depth=args.feed_depth
             )
@@ -393,7 +443,7 @@ def train_net(args):
                     if args.profile and total_steps == 10:
                         jax.profiler.start_trace(args.profile)
                         tracing = True
-                    state, ready, _step_ok = pipeline.step(state, batch, rng)
+                    state, ready, _step_ok = step_loop.step(state, batch, rng)
                     deliver(ready)
                     total_steps += 1
                     batch_in_epoch += 1
@@ -430,6 +480,14 @@ def train_net(args):
                 logger.info("Epoch[%d] checkpoint -> %s", epoch, path)
                 # preemption dumps from this epoch are now superseded
                 prune_step_checkpoints(args.prefix, epoch)
+            if use_elastic:
+                # regrow only here: the boundary save above is the state
+                # a failed regrow would fall back to
+                state, regrown = eloop.checkpoint_boundary(state)
+                if regrown:
+                    logger.info(
+                        "elastic: regrown to %d replicas", len(eloop.active)
+                    )
             if args.max_steps and total_steps >= args.max_steps:
                 break
     finally:
@@ -449,11 +507,31 @@ def train_net(args):
             logger.info(
                 "profiler trace (short run) written to %s", args.profile
             )
+        if use_elastic:
+            if eloop.monitor.shrinks:
+                logger.warning(
+                    "elastic summary: %d shrink(s), %d regrow(s), %d "
+                    "emergency checkpoint(s), %d step(s) replayed, "
+                    "%.2fs total recovery; final mesh %d/%d replicas",
+                    eloop.monitor.shrinks, eloop.monitor.regrows,
+                    len(eloop.emergency_ckpts), eloop.replayed_steps,
+                    eloop.recovery_s, len(eloop.active), n_chips,
+                )
+            if report is not None:
+                report["elastic"] = eloop.stats()
+                report["degraded"] = eloop.degraded
     return state
 
 
 def main():
-    train_net(parse_args())
+    import sys
+
+    report = {}
+    train_net(parse_args(), report=report)
+    if report.get("degraded"):
+        # the run FINISHED, but on a shrunken mesh — tell the scheduler
+        # so it can reschedule at full size if it cares
+        sys.exit(DEGRADED_EXIT_CODE)
 
 
 if __name__ == "__main__":

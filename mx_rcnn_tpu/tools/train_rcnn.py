@@ -32,6 +32,9 @@ def train_rcnn(
     seed: int = 0,
     max_steps: int = 0,
     frequent: int = 20,
+    prefix: Optional[str] = None,
+    resume: bool = False,
+    stream_log: Optional[str] = None,
 ) -> tuple[Dict, Config]:
     """Train Fast-RCNN on a proposal roidb; returns (params, cfg_used).
 
@@ -58,6 +61,7 @@ def train_rcnn(
         epochs=epochs, seed=seed, init_donor=init_donor,
         fixed_params=fixed, max_steps=max_steps, frequent=frequent,
         proposal_count=cfg.TRAIN.RPN_POST_NMS_TOP_N,
+        prefix=prefix, resume=resume, stream_log=stream_log,
     )
     return params, cfg
 
@@ -80,6 +84,12 @@ def main():
     p.add_argument("--max_steps", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--cpu", type=int, default=0)
+    p.add_argument("--prefix", default=None,
+                   help="checkpoint dir (enables preemption-safe saves)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest checkpoint under --prefix")
+    p.add_argument("--stream_log", default=None,
+                   help="append per-batch digests here (resume audits)")
     args = p.parse_args()
     if args.cpu:
         from mx_rcnn_tpu.utils.platform import force_cpu
@@ -109,6 +119,7 @@ def main():
     params, cfg_used = train_rcnn(
         cfg, roidb, epochs=args.epochs, init_donor=donor,
         seed=args.seed, max_steps=args.max_steps,
+        prefix=args.prefix, resume=args.resume, stream_log=args.stream_log,
     )
     save_params(args.out, params)
     from mx_rcnn_tpu.utils.run_meta import save_run_meta

@@ -30,17 +30,22 @@ def train_rpn(
     seed: int = 0,
     max_steps: int = 0,
     frequent: int = 20,
+    prefix: Optional[str] = None,
+    resume: bool = False,
+    stream_log: Optional[str] = None,
 ) -> Dict:
     """Train an RPN; returns its params {backbone, rpn}.
 
     ``frozen_shared`` freezes FIXED_PARAMS_SHARED (stage-4 semantics:
-    shared convs pinned to the donor's weights)."""
+    shared convs pinned to the donor's weights).  ``prefix``/``resume``
+    enable checkpointed + preemptible training (see :func:`fit`)."""
     fixed = cfg.network.FIXED_PARAMS_SHARED if frozen_shared else None
     model = RPNOnly(cfg, fixed_params=fixed)
     return fit(
         model, cfg, roidb,
         epochs=epochs, seed=seed, init_donor=init_donor,
         fixed_params=fixed, max_steps=max_steps, frequent=frequent,
+        prefix=prefix, resume=resume, stream_log=stream_log,
     )
 
 
@@ -61,6 +66,12 @@ def main():
     p.add_argument("--max_steps", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--cpu", type=int, default=0)
+    p.add_argument("--prefix", default=None,
+                   help="checkpoint dir (enables preemption-safe saves)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest checkpoint under --prefix")
+    p.add_argument("--stream_log", default=None,
+                   help="append per-batch digests here (resume audits)")
     args = p.parse_args()
     if args.cpu:
         from mx_rcnn_tpu.utils.platform import force_cpu
@@ -94,6 +105,7 @@ def main():
     params = train_rpn(
         cfg, roidb, epochs=args.epochs, init_donor=donor,
         seed=args.seed, max_steps=args.max_steps,
+        prefix=args.prefix, resume=args.resume, stream_log=args.stream_log,
     )
     save_params(args.out, params)
     from mx_rcnn_tpu.utils.run_meta import save_run_meta

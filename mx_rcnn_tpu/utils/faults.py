@@ -57,11 +57,29 @@ controller's rollback path::
                              the committed version must roll back to
                              the previous LIVE between batches
 
+Device-phase injectors (ISSUE 9, elastic training) are keyed
+``STEP.REPLICA`` — the global train-step index at which the fault
+strikes and the victim replica's ordinal in the BASE (full) mesh.  The
+optional ``:DUR`` argument is a deterministic *down-window in steps*:
+the replica answers :func:`down_replicas` probes as dead for stream
+positions in ``[STEP, STEP+DUR)`` and healthy after, which is what
+drives regrow without a single wall-clock sleep::
+
+    device_lost@S.R[:DUR]    raise InjectedDeviceFault("device_lost")
+                             when step S dispatches while replica R is
+                             active.  DUR 0 (the default) = the replica
+                             never returns.
+    device_wedge@S.R[:DUR]   the wedged-collective flavor (default DUR
+                             8: the hang clears and the replica is
+                             eligible to rejoin at a later checkpoint
+                             boundary).
+
 Example::
 
     MX_RCNN_FAULTS="nan_loss@5,record_fail@3,save_crash@2,stall@7:30"
     MX_RCNN_FAULTS="predict_fail@0.2x1,replica_wedge@1.0:3,predict_stall@2.*x4:0.4"
     MX_RCNN_FAULTS="swap_verify_fail@1,canary_fail@2"
+    MX_RCNN_FAULTS="device_lost@4.2,device_wedge@3.5:4"
 
 Injection sites are no-ops (one env lookup) when the variable is unset,
 so production paths pay nothing.
@@ -99,6 +117,19 @@ class InjectedSwapFault(RuntimeError):
     exactly like a real verification/warmup/canary failure."""
 
 
+class InjectedDeviceFault(RuntimeError):
+    """Raised by the device-phase injector at a train-step dispatch — a
+    RuntimeError (like jax's XlaRuntimeError), so the elastic loop's
+    classification treats it exactly like a real device loss.  Carries
+    the victim coordinates: ``replica`` (base-mesh ordinal) and
+    ``fault_kind`` ("device_lost" | "device_wedge")."""
+
+    def __init__(self, msg: str, replica: int, fault_kind: str):
+        super().__init__(msg)
+        self.replica = replica
+        self.fault_kind = fault_kind
+
+
 # serve-phase kinds take the compound REPLICA.ORDINAL key
 _SERVE_KINDS = ("predict_fail", "predict_stall", "replica_wedge")
 
@@ -108,6 +139,9 @@ _SWAP_KINDS = {
     "warm": "swap_warm_fail",
     "canary": "canary_fail",
 }
+
+# device-phase kinds (elastic training) take the compound STEP.REPLICA key
+_DEVICE_KINDS = ("device_lost", "device_wedge")
 
 # every kind some hook consults — graftlint R6 cross-checks this against
 # the hook bodies, so the whitelist cannot drift from the implementation
@@ -121,6 +155,7 @@ _KNOWN_KINDS = frozenset(
     }
     | set(_SERVE_KINDS)
     | set(_SWAP_KINDS.values())
+    | set(_DEVICE_KINDS)
 )
 
 
@@ -182,7 +217,8 @@ def _parse(spec: str) -> List[_Fault]:
             rest, _, times_s = rest.partition("x")
             times = int(times_s)
         defaults = {"spike": 1e4, "stall": 5.0,
-                    "predict_stall": 0.25, "replica_wedge": 5.0}
+                    "predict_stall": 0.25, "replica_wedge": 5.0,
+                    "device_wedge": 8.0}
         out.append(
             _Fault(
                 kind=kind,
@@ -288,6 +324,56 @@ def predict_fault(replica: int, ordinal: int) -> None:
             )
         time.sleep(f.arg)
         return
+
+
+def device_fault(step: int, active=None) -> None:
+    """Elastic-loop dispatch hook (``parallel/elastic.py``): strike a
+    replica at train step ``step``.  ``active`` is the sequence of
+    base-mesh ordinals currently IN the mesh — a fault whose victim has
+    already been shrunk away cannot fire again, which is exactly what
+    makes the post-shrink replay of the poison step deterministic (the
+    same coordinate re-dispatches, the dead replica is gone, no raise).
+    The first matching un-exhausted fault raises
+    :class:`InjectedDeviceFault` carrying the victim ordinal."""
+    reg = _active()
+    if reg is None:
+        return
+    for f in reg.faults:
+        if f.kind not in _DEVICE_KINDS or not isinstance(f.key, tuple):
+            continue
+        s, r = f.key
+        if s != step or r is None:
+            continue
+        if active is not None and r not in active:
+            continue
+        if f.fire():
+            raise InjectedDeviceFault(
+                f"injected {f.kind}: replica {r} at step {step}"
+                + (f" (down for {int(f.arg)} step(s))" if f.arg else ""),
+                replica=r, fault_kind=f.kind,
+            )
+
+
+def down_replicas(step: int) -> frozenset:
+    """Non-raising probe: which base-mesh replica ordinals are inside a
+    device fault's down-window at stream position ``step``.  Purely a
+    function of the spec and the step index — a replayed run sees the
+    identical health timeline, so regrow decisions (taken at checkpoint
+    boundaries against this probe) are deterministic.  A ``device_lost``
+    with no ``:DUR`` never clears."""
+    reg = _active()
+    if reg is None:
+        return frozenset()
+    down = set()
+    for f in reg.faults:
+        if f.kind not in _DEVICE_KINDS or not isinstance(f.key, tuple):
+            continue
+        s, r = f.key
+        if r is None or step < s:
+            continue
+        if f.arg <= 0 or step < s + int(f.arg):
+            down.add(r)
+    return frozenset(down)
 
 
 def swap_fault(stage: str, ordinal: int) -> None:

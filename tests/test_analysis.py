@@ -21,6 +21,7 @@ from mx_rcnn_tpu.analysis.rules_futures import ExactlyOnce
 from mx_rcnn_tpu.analysis.rules_hostcopy import HostCopyEscape, UseAfterDonate
 from mx_rcnn_tpu.analysis.rules_jit import JitPurity
 from mx_rcnn_tpu.analysis.rules_locks import LockOrder
+from mx_rcnn_tpu.analysis.rules_signals import SignalSafety
 
 REPO = Path(__file__).resolve().parents[1]
 
@@ -411,6 +412,98 @@ def test_r6_fires_on_known_kinds_drift():
     assert any("'kb'" in f.message for f in report.findings)
 
 
+# ---------------------------------------------------------------- R7
+
+R7_BAD = """
+import signal
+import threading
+import jax
+from mx_rcnn_tpu.utils import faults
+
+class Guard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        signal.signal(signal.SIGTERM, self._handle)
+
+    def _handle(self, signum, frame):
+        with self._lock:
+            self.flag = True
+        faults.crash_save()
+        self._snapshot()
+
+    def _snapshot(self):
+        self.snap = jax.device_get(self.state)
+"""
+
+R7_BAD_MODULE_FN = """
+import signal
+
+def _save():
+    from mx_rcnn_tpu.core.resilience import host_copy
+    return host_copy({})
+
+def handler(signum, frame):
+    _save()
+
+signal.signal(signal.SIGINT, handler)
+"""
+
+R7_BAD_ACQUIRE = """
+import signal
+
+class G:
+    def _handle(self, signum, frame):
+        self.mu.acquire()
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._handle)
+"""
+
+R7_GOOD = """
+import os
+import signal
+
+class Guard:
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self.should_stop = False
+        self._prev = {}
+        for s in signals:
+            self._prev[s] = signal.signal(s, self._handle)
+
+    def _handle(self, signum, frame):
+        if self.should_stop:
+            signal.signal(signum, self._prev[signum])
+            os.kill(os.getpid(), signum)
+        self.should_stop = True
+"""
+
+
+def test_r7_fires_on_lock_device_and_faults_in_handler():
+    fs = run_rule(R7_BAD, SignalSafety())
+    msgs = " | ".join(f.message for f in fs)
+    assert "acquires lock `_lock`" in msgs
+    assert "fault-injection hook `faults.crash_save`" in msgs
+    # transitive: the device_get lives in a self.* callee of the handler
+    assert "device/placement work `jax.device_get`" in msgs
+    assert all("signal handler `Guard._handle`" in f.message for f in fs)
+
+
+def test_r7_follows_module_function_handler():
+    fs = run_rule(R7_BAD_MODULE_FN, SignalSafety())
+    assert len(fs) == 1 and "host_copy" in fs[0].message
+
+
+def test_r7_fires_on_explicit_acquire():
+    fs = run_rule(R7_BAD_ACQUIRE, SignalSafety())
+    assert len(fs) == 1 and ".acquire()" in fs[0].message
+
+
+def test_r7_silent_on_flag_flip_handler():
+    """The PreemptionGuard shape — flag, handler restore, os.kill
+    re-raise — is the sanctioned handler body and must be clean."""
+    assert run_rule(R7_GOOD, SignalSafety()) == []
+
+
 # ------------------------------------------------- suppression layers
 
 
@@ -614,3 +707,35 @@ def test_bench_artifacts_parse():
     for p in REPO.glob("BENCH_*.json"):
         doc = json.loads(p.read_text())
         assert isinstance(doc, (dict, list)) and doc
+
+
+def test_elastic_artifact_schema_guard(tmp_path):
+    """BENCH_elastic_cpu.json must carry all four chaos scenarios, each
+    with the zero-lost / bit-identical / recovery fields — a bench
+    refactor dropping one is a lint failure, not a silent hole."""
+    good = {
+        "records": [],
+        "report": {
+            "scenarios": {
+                name: {
+                    "recovery_s": 0.1,
+                    "zero_lost_steps": True,
+                    "bit_identical": True,
+                }
+                for name in (
+                    "lose_1_of_8", "wedge", "lose_then_regrow",
+                    "preempt_during_shrink",
+                )
+            }
+        },
+    }
+    art = tmp_path / "BENCH_elastic_cpu.json"
+    art.write_text(json.dumps(good))
+    assert check_bench_artifacts(tmp_path) == []
+
+    del good["report"]["scenarios"]["wedge"]
+    good["report"]["scenarios"]["lose_1_of_8"].pop("bit_identical")
+    art.write_text(json.dumps(good))
+    errs = " | ".join(check_bench_artifacts(tmp_path))
+    assert "scenario 'wedge' missing" in errs
+    assert "'lose_1_of_8' missing 'bit_identical'" in errs

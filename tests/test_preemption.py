@@ -6,9 +6,13 @@ uncommitted/corrupt dumps (ISSUE 1)."""
 import json
 import os
 import signal
+from pathlib import Path
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
 
 from mx_rcnn_tpu.core.checkpoint import (
     MANIFEST,
@@ -124,3 +128,112 @@ def test_prune_step_checkpoints(tmp_path):
     prune_step_checkpoints(p, up_to_epoch=1)
     left = sorted(os.listdir(p))
     assert left == ["epoch_0001", "junk", "step_0002_000007"]
+
+
+def test_prune_retains_newest_committed_step_dump(tmp_path):
+    """Retain guard: a committed mid-epoch dump is never pruned while it
+    is the newest one a resume could actually use — even when its epoch
+    is ≤ up_to_epoch — and a CORRUPT dump that sorts newer by name must
+    not shadow it out of the guard (corrupt-then-committed sequence)."""
+    import os
+
+    from mx_rcnn_tpu.core.checkpoint import prune_step_checkpoints
+
+    p = str(tmp_path)
+    committed = save_checkpoint(p, _state(3.0), epoch=1, batch_in_epoch=4)
+    older = save_checkpoint(p, _state(2.0), epoch=0, batch_in_epoch=6)
+    # killed-before-commit dump, newer-named than both (no manifest)
+    os.makedirs(os.path.join(p, "step_0001_000009"))
+    prune_step_checkpoints(p, up_to_epoch=1)
+    assert os.path.isdir(committed), "newest committed dump was pruned"
+    assert not os.path.isdir(older)  # superseded: prunable as before
+    assert not os.path.isdir(os.path.join(p, "step_0001_000009"))
+    # and the survivor restores: the fallback chain keeps one verifiable
+    # mid-epoch dump
+    from mx_rcnn_tpu.core.checkpoint import load_restorable
+
+    got = load_restorable(p, _state(0.0))
+    assert got is not None and got[0] == (1, 4)
+    np.testing.assert_array_equal(np.asarray(got[1].params["w"]), 3.0)
+
+
+@pytest.mark.slow
+@pytest.mark.deadline(1800)
+def test_sigterm_resume_consumes_identical_stream(tmp_path):
+    """Real-signal integration: SIGTERM a live ``fit`` subprocess
+    mid-epoch; the resumed run must consume a batch stream whose digest
+    log concatenates to EXACTLY an uninterrupted run's — bit-identical
+    data, in order, no gaps, no repeats."""
+    import subprocess
+    import sys
+    import time
+
+    script = tmp_path / "child.py"
+    script.write_text(
+        "import sys\n"
+        "from mx_rcnn_tpu.utils.platform import force_cpu\n"
+        "force_cpu(1)\n"
+        "import dataclasses\n"
+        "from mx_rcnn_tpu.core.fit import fit\n"
+        "from mx_rcnn_tpu.data.synthetic import SyntheticDataset\n"
+        "from mx_rcnn_tpu.models.stage_models import RPNOnly\n"
+        "from tests.test_loader import small_cfg\n"
+        "prefix, log, resume = sys.argv[1], sys.argv[2], sys.argv[3] == '1'\n"
+        "cfg = small_cfg()\n"
+        "cfg = cfg.replace(TRAIN=dataclasses.replace(\n"
+        "    cfg.TRAIN, BATCH_IMAGES=1, SHUFFLE=True))\n"
+        "roidb = SyntheticDataset(num_images=8, num_classes=4,\n"
+        "    image_size=cfg.SHAPE_BUCKETS[0], max_boxes=2).gt_roidb()\n"
+        "fit(RPNOnly(cfg), cfg, roidb, epochs=2, seed=7, prefix=prefix,\n"
+        "    resume=resume, stream_log=log)\n"
+        "print('FIT_DONE', flush=True)\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("MX_RCNN_FAULTS", None)
+
+    def run(prefix, log, resume, fault_env=None, sigterm_after_lines=None):
+        e = dict(env)
+        if fault_env:
+            e["MX_RCNN_FAULTS"] = fault_env
+        proc = subprocess.Popen(
+            [sys.executable, str(script), prefix, log,
+             "1" if resume else "0"],
+            env=e, cwd=str(REPO_ROOT),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        if sigterm_after_lines is not None:
+            deadline = time.monotonic() + 900
+            while time.monotonic() < deadline and proc.poll() is None:
+                try:
+                    n = len(open(log).read().splitlines())
+                except OSError:
+                    n = 0
+                if n >= sigterm_after_lines:
+                    proc.send_signal(signal.SIGTERM)
+                    break
+                time.sleep(0.05)
+        out, _ = proc.communicate(timeout=1500)
+        assert proc.returncode == 0, out
+        return out
+
+    golden_log = str(tmp_path / "golden.log")
+    run(str(tmp_path / "golden"), golden_log, resume=False)
+    golden = open(golden_log).read().splitlines()
+    assert len(golden) == 16  # 8 images / batch 1, 2 epochs
+
+    # preempted run: a long injected stall at step 3 holds the run
+    # mid-epoch while the parent lands a real SIGTERM
+    prefix, log = str(tmp_path / "pre"), str(tmp_path / "pre.log")
+    out = run(prefix, log, resume=False, fault_env="stall@3:8",
+              sigterm_after_lines=4)
+    interrupted = open(log).read().splitlines()
+    assert 0 < len(interrupted) < len(golden), out
+    from mx_rcnn_tpu.core.checkpoint import restorable_checkpoints
+
+    assert restorable_checkpoints(prefix), "no committed dump after SIGTERM"
+
+    # resume appends to the SAME log: the file must become the golden
+    # stream, bit for bit
+    run(prefix, log, resume=True)
+    assert open(log).read().splitlines() == golden
