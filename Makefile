@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-fault swap pipeline elastic chaos integration-gate clean-native
+.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-fault swap slo pipeline elastic chaos integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -100,6 +100,17 @@ swap:
 	JAX_PLATFORMS=cpu $(PY) bench.py --swap --serve_requests 24 \
 	      --serve_concurrency 6 --serve_max_batch 2 --serve_replicas 2 \
 	      --out BENCH_swap_cpu.json
+
+# SLO-tier serving bench (ISSUE 11): sparse interactive probes against
+# a saturating bulk backlog, single-lane baseline vs two-lane scheduling
+# on ONE runner (so the compile cache spans both — the cross-lane
+# zero-recompile evidence); open-loop probes keep the offered
+# interactive rate identical across phases.  Emits per-lane p50/p99,
+# bulk-throughput retention, preemption counts, response-cache
+# byte-identity + hit rate, and the bf16 serve-graph parity report, as
+# JSON lines + the BENCH_serve_slo_cpu.json artifact
+slo:
+	JAX_PLATFORMS=cpu $(PY) bench.py --slo --out BENCH_serve_slo_cpu.json
 
 # device-resident step pipeline bench (ISSUE 4): feed occupancy, fetch
 # stalls, K=1 byte-identical check on the CPU smoke config; emits JSON

@@ -241,6 +241,279 @@ def bench_serve(
     return records, report
 
 
+def _pctl_ms(lats_ms: list, p: float) -> float:
+    """Exact percentile over a small latency sample (sorted interp)."""
+    if not lats_ms:
+        return None
+    return round(float(np.percentile(np.asarray(lats_ms), p)), 3)
+
+
+def _dets_equal(a, b) -> bool:
+    """Byte-level equality of two per-class detections lists."""
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if x is None or y is None:
+            if x is not y:
+                return False
+            continue
+        x, y = np.asarray(x), np.asarray(y)
+        if x.shape != y.shape or x.dtype != y.dtype:
+            return False
+        if x.tobytes() != y.tobytes():
+            return False
+    return True
+
+
+def bench_serve_slo(
+    network: str,
+    probes: int = 5,
+    probe_spacing_s: float = 10.0,
+    bulk_concurrency: int = 32,
+    max_batch: int = 2,
+    backlog_s: float = 2.0,
+    bulk_age_limit: float = 2.0,
+    cache_lookups: int = 8,
+) -> tuple:
+    """SLO-tier serving bench: sparse interactive probes against a
+    saturating bulk backlog, single-lane vs two-lane.
+
+    Two phases over ONE runner (so the compile cache spans both — the
+    cross-lane zero-recompile evidence): a *baseline* phase submits the
+    probes untagged (they queue FIFO behind the backlog, today's
+    single-lane behavior) and a *two-lane* phase tags them
+    ``interactive`` (they preempt bulk for the next device slot).  The
+    probe stream is OPEN-LOOP — one probe every ``probe_spacing_s``
+    regardless of completion — so both phases offer the same interactive
+    arrival rate and the bulk-throughput comparison is apples-to-apples.
+    Bulk is a closed loop of ``bulk_concurrency`` clients that refills
+    until the probes finish (exhaustion can't deflate the baseline).
+
+    ``probe_spacing_s`` sets the retention floor: a two-lane probe takes
+    a whole batch slot (lane-pure batch-of-1) where a baseline probe
+    shares one, so bulk gives up ``max_batch - 1`` image slots per probe
+    — spacing must dwarf the per-batch service time for bulk throughput
+    to hold within the 10% acceptance band.
+
+    Then two short phases on the same registry: an idempotent response-
+    cache phase (same image ``cache_lookups`` times; hits must be
+    byte-identical to the miss) and a bf16 serve-graph phase (a second
+    runner at ``precision="bfloat16"`` whose warmup runs the detection-
+    parity gate against f32 — the report lands in the artifact).
+
+    → (records, report) in the standard artifact shape.
+    """
+    import dataclasses as _dc
+    import threading
+
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.serve.batcher import QueueFull
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.loadgen import synthetic_image
+    from mx_rcnn_tpu.serve.registry import DEFAULT_MODEL, ModelRegistry
+    from mx_rcnn_tpu.serve.respcache import ResponseCache
+    from mx_rcnn_tpu.serve.runner import ServeRunner
+    from mx_rcnn_tpu.tools.serve import random_params, small_config
+
+    # smaller than the serve-bench small_config: scheduling contrast is
+    # the point, so short service times let a deep backlog stay cheap
+    cfg = small_config(network).replace(
+        SHAPE_BUCKETS=((64, 96), (96, 96)),
+    )
+    cfg = cfg.replace(
+        dataset=_dc.replace(cfg.dataset, SCALES=((64, 96),))
+    )
+    bulk_sizes = ((48, 64), (64, 72), (96, 64))  # 2 rungs exercised
+    probe_hw = (48, 64)                          # smallest rung
+    model = build_model(cfg)
+    params = random_params(model, cfg, 0)
+    registry = ModelRegistry()
+    registry.register(DEFAULT_MODEL, model, cfg, params)
+    runner = ServeRunner(registry=registry, max_batch=max_batch)
+    misses_warm = runner.warmup()
+
+    def phase(probe_lane):
+        stop = threading.Event()
+        bulk_ok: list = []
+        bulk_failed: list = []
+        lats_ms: list = []
+        idx_lock = threading.Lock()
+        idx = [0]
+
+        engine = ServingEngine(
+            runner, max_queue=128, in_flight=1,
+            bulk_age_limit=bulk_age_limit,
+        )
+
+        def bulk_client():
+            while not stop.is_set():
+                with idx_lock:
+                    i = idx[0]
+                    idx[0] += 1
+                h, w = bulk_sizes[i % len(bulk_sizes)]
+                im = synthetic_image(i, h, w, seed=0)
+                try:
+                    fut = engine.submit(im)
+                except QueueFull:
+                    time.sleep(0.005)
+                    continue
+                except RuntimeError:
+                    return  # engine stopping
+                try:
+                    fut.result()
+                    bulk_ok.append(1)
+                except Exception:  # noqa: BLE001 — counted, not fatal
+                    bulk_failed.append(1)
+
+        with engine:
+            clients = [
+                threading.Thread(target=bulk_client, daemon=True,
+                                 name=f"slo-bulk-{t}")
+                for t in range(bulk_concurrency)
+            ]
+            for t in clients:
+                t.start()
+            time.sleep(backlog_s)  # saturate before the first probe
+            t_win = time.monotonic()
+            n0 = len(bulk_ok)
+            futs = []
+            for k in range(probes):
+                im = synthetic_image(1_000_000 + k, *probe_hw, seed=1)
+                lkw = {} if probe_lane is None else {"lane": probe_lane}
+                t0 = time.monotonic()
+                f = engine.submit(im, **lkw)
+                f.add_done_callback(
+                    lambda _f, _t0=t0: lats_ms.append(
+                        (time.monotonic() - _t0) * 1000.0
+                    )
+                )
+                futs.append(f)
+                time.sleep(probe_spacing_s)
+            for f in futs:
+                f.result()  # raises if any probe failed
+            window = time.monotonic() - t_win
+            bulk_done = len(bulk_ok) - n0
+            stop.set()
+        for t in clients:
+            t.join(timeout=30.0)
+        snap = engine.snapshot()
+        r = snap["requests"]
+        return {
+            "probe_lane": probe_lane or "untagged(bulk)",
+            "interactive_ms": {
+                "p50": _pctl_ms(lats_ms, 50),
+                "p99": _pctl_ms(lats_ms, 99),
+                "samples": sorted(round(x, 1) for x in lats_ms),
+            },
+            "bulk_imgs_per_sec": round(bulk_done / window, 3),
+            "bulk_completed_in_window": bulk_done,
+            "bulk_failed": len(bulk_failed),
+            "window_s": round(window, 3),
+            "lost_requests": (
+                r["submitted"] - r["completed"] - r["failed"]
+                - r["expired"] - r["stopped"]
+            ),
+            "scheduler": snap["scheduler"],
+            "lanes": snap.get("lanes", {}),
+        }
+
+    baseline = phase(None)
+    two_lane = phase("interactive")
+    misses_steady = runner.compile_cache.misses - misses_warm
+
+    # --- idempotent response cache: same image again must be a hit and
+    # byte-identical to what the miss computed
+    cache = ResponseCache(capacity=32)
+    with ServingEngine(runner, response_cache=cache) as engine:
+        im = synthetic_image(424_242, *probe_hw, seed=2)
+        ref = engine.submit(im).result()
+        hits = [
+            engine.submit(im).result() for _ in range(cache_lookups)
+        ]
+    cache_identical = all(_dets_equal(ref, h) for h in hits)
+    cache_snap = cache.snapshot()
+
+    # --- bf16 serve graph: second runner on the SAME registry/params;
+    # its warmup runs the f32 detection-parity gate (raises on drift)
+    runner_bf16 = ServeRunner(
+        registry=registry, max_batch=max_batch, precision="bfloat16"
+    )
+    runner_bf16.warmup()
+    parity = dict(runner_bf16.parity[registry.default_model])
+
+    def service_s(r):
+        req = r.make_request(synthetic_image(7, *probe_hw, seed=3))
+        b = r.assemble([req])
+        r.run(b)
+        t0 = time.monotonic()
+        for _ in range(3):
+            r.run(b)
+        return round((time.monotonic() - t0) / 3, 4)
+
+    svc = {"f32": service_s(runner), "bf16": service_s(runner_bf16)}
+
+    p99_base = baseline["interactive_ms"]["p99"]
+    p99_two = two_lane["interactive_ms"]["p99"]
+    speedup = round(p99_base / p99_two, 2) if p99_two else None
+    retention = (
+        round(
+            two_lane["bulk_imgs_per_sec"] / baseline["bulk_imgs_per_sec"], 4
+        )
+        if baseline["bulk_imgs_per_sec"] else None
+    )
+    report = {
+        "config": {
+            "network": network,
+            "buckets": [list(b) for b in cfg.SHAPE_BUCKETS],
+            "max_batch": max_batch,
+            "probes": probes,
+            "probe_spacing_s": probe_spacing_s,
+            "bulk_concurrency": bulk_concurrency,
+            "bulk_age_limit": bulk_age_limit,
+        },
+        "baseline": baseline,
+        "two_lane": two_lane,
+        "compile": {
+            "warmup_misses": misses_warm,
+            "steady_state_misses": misses_steady,
+        },
+        "response_cache": dict(cache_snap, byte_identical=cache_identical),
+        "bf16": {"parity": parity, "service_s": svc},
+    }
+    tag = _METRIC_NAMES[network].replace("_e2e", "")
+    records = [
+        {"metric": f"serve_slo_interactive_p99_ms_baseline_{tag}",
+         "value": p99_base, "unit": "ms", "vs_baseline": None},
+        {"metric": f"serve_slo_interactive_p99_ms_two_lane_{tag}",
+         "value": p99_two, "unit": "ms", "vs_baseline": None},
+        {"metric": f"serve_slo_interactive_p99_speedup_{tag}",
+         "value": speedup, "unit": "x", "vs_baseline": None},
+        {"metric": f"serve_slo_bulk_imgs_per_sec_baseline_{tag}",
+         "value": baseline["bulk_imgs_per_sec"], "unit": "imgs/sec",
+         "vs_baseline": None},
+        {"metric": f"serve_slo_bulk_imgs_per_sec_two_lane_{tag}",
+         "value": two_lane["bulk_imgs_per_sec"], "unit": "imgs/sec",
+         "vs_baseline": None},
+        {"metric": f"serve_slo_bulk_retention_{tag}",
+         "value": retention, "unit": "fraction", "vs_baseline": None},
+        {"metric": f"serve_slo_preemptions_{tag}",
+         "value": two_lane["scheduler"]["preemptions"], "unit": "count",
+         "vs_baseline": None},
+        {"metric": f"serve_slo_cache_hit_rate_{tag}",
+         "value": cache_snap["hit_rate"], "unit": "fraction",
+         "vs_baseline": None},
+        {"metric": f"serve_slo_steady_state_compile_misses_{tag}",
+         "value": misses_steady, "unit": "compiles", "vs_baseline": None},
+        {"metric": f"serve_slo_lost_requests_{tag}",
+         "value": baseline["lost_requests"] + two_lane["lost_requests"],
+         "unit": "count", "vs_baseline": None},
+        {"metric": f"serve_slo_bf16_parity_max_box_delta_px_{tag}",
+         "value": parity.get("max_box_delta_px"), "unit": "px",
+         "vs_baseline": None},
+    ]
+    return records, report
+
+
 # serve-fault scenario grid: one MX_RCNN_FAULTS spec per scenario.
 # Ordinal 0 on every replica is its initial warmup probe, so injected
 # ordinals start at 1 to land on live traffic, not warmup.
@@ -1316,6 +1589,17 @@ def main():
              "byte-identical + recovery-time evidence)",
     )
     ap.add_argument(
+        "--slo", action="store_true",
+        help="SLO-tier serving bench: sparse interactive probes vs a "
+             "saturating bulk backlog, single-lane baseline vs two-lane "
+             "(interactive p99 + bulk-throughput retention + zero "
+             "recompiles), plus response-cache byte-identity and the "
+             "bf16 serve-graph parity gate",
+    )
+    ap.add_argument("--slo_probes", type=int, default=5)
+    ap.add_argument("--slo_probe_spacing", type=float, default=10.0)
+    ap.add_argument("--slo_bulk_concurrency", type=int, default=32)
+    ap.add_argument(
         "--swap", action="store_true",
         help="model-lifecycle serving bench: live hot-swap under load "
              "(zero lost, byte-identical outside the swap window, zero "
@@ -1413,6 +1697,21 @@ def main():
         records, report = bench_pipeline(
             args.pipeline_steps, args.aux_interval, args.feed_depth,
             args.pipeline_batch,
+        )
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
+
+    if args.slo:
+        network = "resnet50" if args.network == "resnet" else args.network
+        records, report = bench_serve_slo(
+            network, probes=args.slo_probes,
+            probe_spacing_s=args.slo_probe_spacing,
+            bulk_concurrency=args.slo_bulk_concurrency,
+            max_batch=args.serve_max_batch // 2 or 1,
         )
         for rec in records:
             print(json.dumps(rec), flush=True)

@@ -46,6 +46,62 @@ def _check_elastic_schema(name: str, doc: dict) -> List[str]:
     return errors
 
 
+# the SLO artifact must keep proving the two-lane claims: per-lane
+# latency phases, bulk-throughput retention, compile stability, and the
+# response-cache + bf16-parity evidence (ISSUE 11 acceptance shape)
+_SLO_REPORT_KEYS = ("baseline", "two_lane", "compile", "response_cache",
+                    "bf16")
+_SLO_PHASE_KEYS = ("interactive_ms", "bulk_imgs_per_sec", "lost_requests",
+                   "scheduler")
+_SLO_METRIC_PREFIXES = (
+    "serve_slo_interactive_p99_ms_baseline",
+    "serve_slo_interactive_p99_ms_two_lane",
+    "serve_slo_interactive_p99_speedup",
+    "serve_slo_bulk_retention",
+    "serve_slo_cache_hit_rate",
+    "serve_slo_steady_state_compile_misses",
+    "serve_slo_lost_requests",
+)
+
+
+def _check_slo_schema(name: str, doc: dict) -> List[str]:
+    errors = []
+    report = doc.get("report") if isinstance(doc, dict) else None
+    if not isinstance(report, dict):
+        return [f"bench artifact {name}: missing report object"]
+    for k in _SLO_REPORT_KEYS:
+        if k not in report:
+            errors.append(f"bench artifact {name}: report.{k} missing")
+    for phase in ("baseline", "two_lane"):
+        p = report.get(phase)
+        if not isinstance(p, dict):
+            continue
+        for k in _SLO_PHASE_KEYS:
+            if k not in p:
+                errors.append(
+                    f"bench artifact {name}: report.{phase}.{k} missing"
+                )
+    cache = report.get("response_cache")
+    if isinstance(cache, dict) and "byte_identical" not in cache:
+        errors.append(
+            f"bench artifact {name}: response_cache.byte_identical missing"
+        )
+    bf16 = report.get("bf16")
+    if isinstance(bf16, dict) and "parity" not in bf16:
+        errors.append(f"bench artifact {name}: bf16.parity missing")
+    metrics = {
+        r.get("metric", "")
+        for r in doc.get("records", [])
+        if isinstance(r, dict)
+    }
+    for prefix in _SLO_METRIC_PREFIXES:
+        if not any(m.startswith(prefix) for m in metrics):
+            errors.append(
+                f"bench artifact {name}: no record metric '{prefix}*'"
+            )
+    return errors
+
+
 def check_bench_artifacts(root: Path) -> List[str]:
     errors = []
     for f in sorted(root.glob("BENCH_*.json")):
@@ -59,6 +115,8 @@ def check_bench_artifacts(root: Path) -> List[str]:
             continue
         if f.name == "BENCH_elastic_cpu.json":
             errors += _check_elastic_schema(f.name, doc)
+        if f.name == "BENCH_serve_slo_cpu.json":
+            errors += _check_slo_schema(f.name, doc)
     return errors
 
 

@@ -1,26 +1,50 @@
-"""Deadline-aware dynamic micro-batcher.
+"""Deadline-aware dynamic micro-batcher with SLO-tiered two-lane release.
 
 Requests arrive one at a time; the device wants full fixed-shape batches.
-The batcher holds a bounded per-bucket queue and releases a batch when
-either (a) some bucket has ``max_batch`` requests waiting — the happy
-saturated path — or (b) the oldest request has lingered ``max_linger``
-seconds, or (c) the oldest request's deadline is close enough that
-waiting any longer would blow it.  Linger is the single latency/
-throughput knob: 0 gives batch-of-1 dispatch latency, large values give
-full batches under light load at the cost of tail latency.
+Every request carries an SLO lane — ``"interactive"`` or ``"bulk"`` —
+and the batcher holds a bounded per-(model, bucket, lane) queue.
+
+Release policy, in priority order:
+
+1. **bulk-aging guard** — when the bulk head has waited
+   ``bulk_age_limit`` seconds AND the bulk lane has not released a batch
+   for that long, bulk takes the next device slot unconditionally, so a
+   sustained interactive stream can bound bulk's throughput but never
+   starve it.  Both conditions matter: under a deep bulk backlog every
+   head is old (queue wait alone exceeds any limit), so head age by
+   itself would invert the priority exactly when the two-lane split is
+   most needed — the release-gap condition keeps the guard about
+   starvation, not backlog depth.
+2. **interactive lane** — the oldest interactive head preempts bulk for
+   the next slot, releasing with ``interactive_linger`` (default 0:
+   batch-of-1 dispatch latency; a saturated interactive queue still
+   releases full batches).
+3. **bulk lane** — today's max-occupancy behavior: release when some
+   group has ``max_batch`` requests waiting, when the oldest request has
+   lingered ``max_linger`` seconds, or when its deadline is close enough
+   that waiting longer would blow it.
+
+Lanes choose WHICH group releases next; a released batch is still
+homogeneous in (model, bucket) — one model family and one (H, W) canvas
+per device batch — so every batch pads to a single jit signature and the
+zero-recompile invariant is untouched by lane scheduling.  (Batches are
+also lane-pure, which is what makes per-lane occupancy attributable.)
+
+Expired-request sweep: a request whose deadline has already passed would
+otherwise occupy queue and batch slots until pickup.  ``submit`` and
+``next_batch`` sweep such requests — skipping any group that is about to
+release, whose expiry the engine's pickup check already owns — resolve
+their futures with :class:`DeadlineExceeded` immediately (or hand them
+to ``on_expired`` when the engine wires one), and count them in
+``expired_swept``.  The submit-side sweep runs BEFORE the capacity
+check, so backpressure admits fresh work exactly when the system is
+overloaded with dead work.
 
 Backpressure is a bounded total queue: ``submit`` raises
 :class:`QueueFull` instead of buffering unboundedly (the caller — an RPC
 edge in a real deployment — surfaces it as 429/503 and the client backs
 off).  This mirrors GuardedLoop's philosophy in ``core/resilience.py``:
 fail loudly at the boundary rather than degrade invisibly.
-
-Grouping is strictly per (model, bucket) — one model family and one
-(H, W) canvas per device batch — so every released batch pads to a
-single jit signature; cross-bucket (or cross-model) mixing would
-reintroduce the recompile problem the ladder exists to prevent.  The
-``model`` key is None for single-model deployments, so multi-tenancy
-(ISSUE 7) costs nothing when unused.
 """
 
 from __future__ import annotations
@@ -30,13 +54,24 @@ import time
 from collections import deque
 
 from mx_rcnn_tpu.analysis.lockcheck import make_condition
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: SLO lanes, in preemption-priority order.
+LANES = ("interactive", "bulk")
+DEFAULT_LANE = "bulk"
 
 
 class QueueFull(RuntimeError):
     """Bounded queue is at capacity — reject the request (backpressure)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before the device could run it.
+    (Defined here so the batcher's expired-request sweep can resolve
+    futures without importing the engine; ``serve.engine`` re-exports
+    it, which is where most callers import it from.)"""
 
 
 @dataclass
@@ -58,6 +93,8 @@ class Request:
     future: Future = field(default_factory=Future)
     picked_t: float = 0.0                # set by next_batch (queue-wait metric)
     model: Optional[str] = None          # registry model id (None = default)
+    lane: str = DEFAULT_LANE             # SLO class: "interactive" | "bulk"
+    cache_key: Optional[Tuple] = None    # response-cache key (engine-set)
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -66,7 +103,7 @@ class Request:
 
 
 class DynamicBatcher:
-    """Thread-safe bucket-grouped micro-batcher (N producers, 1 consumer).
+    """Thread-safe lane-scheduled micro-batcher (N producers, 1 consumer).
 
     ``next_batch`` blocks until a batch is ready per the release rules
     above, and returns ``None`` once closed and drained.
@@ -77,23 +114,40 @@ class DynamicBatcher:
         max_batch: int,
         max_linger: float = 0.005,
         max_queue: int = 64,
+        interactive_linger: float = 0.0,
+        bulk_age_limit: float = 2.0,
+        on_expired: Optional[Callable[[Request, float], None]] = None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.max_batch = int(max_batch)
         self.max_linger = float(max_linger)
         self.max_queue = int(max_queue)
-        # keyed (model, bucket): a batch is homogeneous in BOTH
+        self.interactive_linger = float(interactive_linger)
+        self.bulk_age_limit = float(bulk_age_limit)
+        # engine hook: resolves a swept request's future + its metrics;
+        # when unset the sweep resolves the future itself
+        self.on_expired = on_expired
+        # keyed (model, bucket, lane): a batch is homogeneous in ALL three
         self._queues: Dict[Tuple, deque] = {}
         self._count = 0
         self._closed = False
         self._cond = make_condition("DynamicBatcher._cond")
+        self._last_bulk_release = time.monotonic()
+        # scheduler counters (engine snapshot merges stats())
+        self.preemptions = 0        # interactive released while bulk waited
+        self.aged_releases = 0      # bulk released via the aging guard
+        self.expired_swept = 0      # dead requests removed pre-pickup
+        self.released = {lane: 0 for lane in LANES}  # batches per lane
 
     # ------------------------------------------------------------- producers
     def submit(self, req: Request) -> None:
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")
+            # free dead capacity before judging fullness: under overload
+            # with deadlines, expired requests must not hold live ones out
+            self._sweep_expired(time.monotonic())
             if self._count >= self.max_queue:
                 raise QueueFull(
                     f"serving queue at capacity ({self.max_queue}) — "
@@ -101,9 +155,11 @@ class DynamicBatcher:
                 )
             if not req.enqueue_t:
                 req.enqueue_t = time.monotonic()
-            self._queues.setdefault((req.model, req.bucket), deque()).append(
-                req
-            )
+            if req.lane not in LANES:
+                raise ValueError(f"unknown SLO lane {req.lane!r}")
+            self._queues.setdefault(
+                (req.model, req.bucket, req.lane), deque()
+            ).append(req)
             self._count += 1
             self._cond.notify()
 
@@ -118,46 +174,134 @@ class DynamicBatcher:
             self._cond.notify_all()
 
     # -------------------------------------------------------------- consumer
-    def _oldest_bucket(self) -> Optional[Tuple]:
-        """(model, bucket) key whose head request has waited longest."""
-        best, best_t = None, None
-        for key, q in self._queues.items():
-            if q and (best_t is None or q[0].enqueue_t < best_t):
-                best, best_t = key, q[0].enqueue_t
-        return best
-
-    def _release_time(self, head: Request) -> float:
-        """Latest moment worth waiting for more traffic on head's bucket."""
-        cut = head.enqueue_t + self.max_linger
+    def _release_time(self, head: Request, linger: float) -> float:
+        """Latest moment worth waiting for more traffic on head's group."""
+        cut = head.enqueue_t + linger
         if head.deadline is not None:
             # don't linger past the deadline itself; the engine budgets
             # execution time via its own expiry check at pickup
             cut = min(cut, head.deadline)
         return cut
 
+    def _select(self, now: float) -> Optional[Tuple[Tuple, float, Optional[str]]]:
+        """Lane-policy pick: (key, release_at, flag) for the group to
+        serve next, or None when empty.  ``flag`` is "aged" when the
+        bulk-aging guard fired, "preempt" when interactive jumped a
+        waiting bulk head, else None."""
+        oldest = {lane: None for lane in LANES}  # lane → (enqueue_t, key)
+        for key, q in self._queues.items():
+            if not q:
+                continue
+            t = q[0].enqueue_t
+            lane = key[2]
+            if oldest[lane] is None or t < oldest[lane][0]:
+                oldest[lane] = (t, key)
+        bulk, inter = oldest["bulk"], oldest["interactive"]
+        if (
+            bulk is not None
+            and now - bulk[0] >= self.bulk_age_limit
+            and now - self._last_bulk_release >= self.bulk_age_limit
+        ):
+            return bulk[1], now, "aged"
+        if inter is not None:
+            head = self._queues[inter[1]][0]
+            ready = self._release_time(head, self.interactive_linger)
+            return inter[1], ready, ("preempt" if bulk is not None else None)
+        if bulk is not None:
+            head = self._queues[bulk[1]][0]
+            return bulk[1], self._release_time(head, self.max_linger), None
+        return None
+
+    def _expire_one(self, req: Request, now: float) -> None:
+        cb = self.on_expired
+        if cb is not None:
+            cb(req, now)
+            return
+        try:
+            req.future.set_exception(
+                DeadlineExceeded(
+                    f"deadline passed {now - req.deadline:.3f}s before "
+                    f"device pickup (swept from queue)"
+                )
+            )
+        except InvalidStateError:
+            pass
+
+    def _sweep_expired(self, now: float, skip: Optional[Tuple] = None) -> int:
+        """Drop every expired queued request (holding ``_cond``), resolve
+        each future immediately, free its capacity.  ``skip`` exempts the
+        group about to release — an expired head that is already
+        releasable belongs to the engine's pickup-time expiry check (and
+        to existing release semantics), not the sweep."""
+        swept: List[Request] = []
+        for key, q in self._queues.items():
+            if key == skip or not q:
+                continue
+            if not any(r.deadline is not None and r.expired(now) for r in q):
+                continue
+            kept = deque()
+            while q:
+                r = q.popleft()
+                if r.deadline is not None and r.expired(now):
+                    swept.append(r)
+                else:
+                    kept.append(r)
+            self._queues[key] = kept
+        if swept:
+            self._count -= len(swept)
+            self.expired_swept += len(swept)
+            for r in swept:
+                self._expire_one(r, now)
+            self._cond.notify_all()  # capacity freed: wake blocked producers
+        return len(swept)
+
     def next_batch(self, poll: float = 0.05) -> Optional[List[Request]]:
-        """Block for the next (model, bucket)-homogeneous batch (≤
+        """Block for the next (model, bucket, lane)-homogeneous batch (≤
         ``max_batch`` requests, FIFO within the group).  ``None`` =
         closed + drained."""
         with self._cond:
             while True:
-                key = self._oldest_bucket()
-                if key is None:
+                now = time.monotonic()
+                choice = self._select(now)
+                if choice is None:
                     if self._closed:
                         return None
                     self._cond.wait(timeout=poll)
                     continue
+                key, release_at, flag = choice
                 q = self._queues[key]
-                now = time.monotonic()
                 full = len(q) >= self.max_batch
-                if full or self._closed or now >= self._release_time(q[0]):
+                if full or self._closed or now >= release_at:
                     n = min(len(q), self.max_batch)
                     batch = [q.popleft() for _ in range(n)]
                     self._count -= n
                     for r in batch:
                         r.picked_t = now
+                    if flag == "aged":
+                        self.aged_releases += 1
+                    elif flag == "preempt":
+                        self.preemptions += 1
+                    self.released[key[2]] += 1
+                    if key[2] == "bulk":
+                        self._last_bulk_release = now
+                    # the released group's own expiry is pickup-checked by
+                    # the engine; everything still queued gets swept here
+                    self._sweep_expired(now)
                     self._cond.notify_all()
                     return batch
+                if self._sweep_expired(now, skip=key):
+                    continue  # queues changed: re-select before sleeping
                 # sleep until the head's release time, a new arrival, or
-                # close — whichever first
-                self._cond.wait(timeout=min(self._release_time(q[0]) - now, poll))
+                # close — whichever first (poll also bounds how stale the
+                # aging-guard check can get)
+                self._cond.wait(timeout=min(release_at - now, poll))
+
+    # ---------------------------------------------------------- reporting
+    def stats(self) -> Dict:
+        with self._cond:
+            return {
+                "preemptions": self.preemptions,
+                "aged_releases": self.aged_releases,
+                "expired_swept": self.expired_swept,
+                "batches_by_lane": dict(self.released),
+            }

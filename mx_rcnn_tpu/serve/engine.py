@@ -49,13 +49,23 @@ import numpy as np
 from mx_rcnn_tpu.core.resilience import RetryPolicy, make_retry_policy
 from mx_rcnn_tpu.analysis.lockcheck import make_lock
 from mx_rcnn_tpu.data.assembler import CompletionPool
-from mx_rcnn_tpu.serve.batcher import DynamicBatcher, QueueFull, Request
+from mx_rcnn_tpu.serve.batcher import (
+    DEFAULT_LANE,
+    DeadlineExceeded,
+    DynamicBatcher,
+    LANES,
+    QueueFull,
+    Request,
+)
 from mx_rcnn_tpu.serve.metrics import ServeMetrics
 from mx_rcnn_tpu.serve.runner import ServeRunner
 
-
-class DeadlineExceeded(RuntimeError):
-    """The request's deadline passed before the device could run it."""
+# DeadlineExceeded historically lived here; it moved to serve.batcher so
+# the expired-request sweep can raise it without a circular import, and
+# stays re-exported for every existing `from serve.engine import` site.
+__all__ = [
+    "DeadlineExceeded", "EngineStopped", "ServingEngine",
+]
 
 
 class EngineStopped(RuntimeError):
@@ -74,11 +84,25 @@ class ServingEngine:
         max_queue: int = 64,
         in_flight: int = 2,
         retry: Optional[RetryPolicy] = None,
+        interactive_linger: float = 0.0,
+        bulk_age_limit: float = 2.0,
+        response_cache=None,
     ):
         self.runner = runner
         self.batcher = DynamicBatcher(
-            runner.max_batch, max_linger=max_linger, max_queue=max_queue
+            runner.max_batch, max_linger=max_linger, max_queue=max_queue,
+            interactive_linger=interactive_linger,
+            bulk_age_limit=bulk_age_limit,
+            on_expired=self._expire_swept,
         )
+        # idempotent response cache (serve/respcache.py), keyed by image
+        # digest per (model, live version); the registry's live-pointer
+        # hook invalidates on hot-swap so hits can never be stale
+        self.response_cache = response_cache
+        if response_cache is not None:
+            reg = getattr(runner, "registry", None)
+            if reg is not None and hasattr(reg, "subscribe_live"):
+                reg.subscribe_live(response_cache.invalidate_model)
         self.metrics = ServeMetrics()
         self.retry = retry if retry is not None else make_retry_policy("serve")
         self._in_flight = max(1, int(in_flight))
@@ -157,15 +181,42 @@ class ServingEngine:
         self.stop()
 
     # ------------------------------------------------------------- client
+    def _lane_for(self, model: Optional[str], lane: Optional[str]) -> str:
+        """Resolve a request's SLO lane: explicit tag wins, else the
+        model's registry-declared SLO class (an interactive-tier model
+        taints its requests' lane), else bulk."""
+        if lane is not None:
+            if lane not in LANES:
+                raise ValueError(f"unknown SLO lane {lane!r}")
+            return lane
+        reg = getattr(self.runner, "registry", None)
+        if reg is not None and hasattr(reg, "slo_class"):
+            return reg.slo_class(model)
+        return DEFAULT_LANE
+
+    def _live_version(self, model: Optional[str]) -> Optional[int]:
+        """Current live version of ``model`` (None when the runner has no
+        registry — stub runners — or no live version yet)."""
+        reg = getattr(self.runner, "registry", None)
+        if reg is None or not hasattr(reg, "live"):
+            return None
+        try:
+            return int(reg.live(model).version)
+        except Exception:  # noqa: BLE001 — no live version = no caching
+            return None
+
     def submit(
         self,
         im: np.ndarray,
         deadline_s: Optional[float] = None,
         model: Optional[str] = None,
+        lane: Optional[str] = None,
     ) -> Future:
         """Enqueue one image; returns a Future resolving to the
         per-class detections list.  ``model`` selects a registry family
-        (None = the default model — the tenancy request schema).  Raises
+        (None = the default model — the tenancy request schema);
+        ``lane`` tags the SLO class (``"interactive"`` | ``"bulk"``,
+        None = the model's registry default).  Raises
         :class:`~mx_rcnn_tpu.serve.buckets.BucketOverflow` (oversize),
         :class:`~mx_rcnn_tpu.serve.batcher.QueueFull` (backpressure), or
         :class:`~mx_rcnn_tpu.serve.registry.UnknownModel` synchronously
@@ -179,6 +230,34 @@ class ServingEngine:
                 from mx_rcnn_tpu.serve.registry import UnknownModel
 
                 raise UnknownModel(model)
+        lane = self._lane_for(model, lane)
+        cache_key = None
+        if self.response_cache is not None:
+            version = self._live_version(model)
+            if version is not None:
+                t0 = time.monotonic()
+                reg = getattr(self.runner, "registry", None)
+                mid = (
+                    model if model is not None
+                    else getattr(self.runner, "default_model", None)
+                    or reg.default_model
+                )
+                cache_key = self.response_cache.key_for(im, mid, version)
+                hit = self.response_cache.get(cache_key)
+                if hit is not None:
+                    # byte-identical by construction: the stored arrays
+                    # ARE what the miss returned (callers treat
+                    # detections as immutable)
+                    f: Future = Future()
+                    f.set_result(hit)
+                    self.metrics.inc("submitted")
+                    self.metrics.inc("completed")
+                    e2e = time.monotonic() - t0
+                    self.metrics.e2e.record(e2e)
+                    self.metrics.record_lane(lane, e2e_s=e2e)
+                    if model is not None:
+                        self.metrics.record_model(model, e2e)
+                    return f
         if self._routed:
             # load shedding: scale the effective intake capacity by the
             # pool's healthy fraction — when half the replicas are out,
@@ -205,17 +284,39 @@ class ServingEngine:
                 req = self.runner.make_request(
                     im, deadline=deadline, model=model
                 )
+            req.lane = lane
+            req.cache_key = cache_key
             self.batcher.submit(req)
         except Exception:
             self.metrics.inc("rejected")
             raise
         with self._live_lock:
             self._live[id(req)] = req
+            if req.future.done():
+                # a concurrent sweep resolved it between batcher.submit
+                # and here — don't leave a dead entry in the live set
+                self._live.pop(id(req), None)
         self.metrics.inc("submitted")
         self.metrics.record_queue_depth(self.batcher.pending())
         return req.future
 
     # ------------------------------------------------------------- device
+    def _expire_swept(self, req: Request, now: float) -> None:
+        """Batcher sweep hook: a queued request's deadline passed before
+        any batch could include it — fail it NOW (the client has already
+        moved on) instead of letting it occupy queue and batch slots
+        until pickup.  Runs under the batcher's condition lock; both
+        callees only take leaf locks."""
+        self.metrics.inc("expired")
+        self.metrics.record_lane(req.lane, expired=True)
+        self._resolve(
+            req,
+            exc=DeadlineExceeded(
+                f"deadline passed {now - req.deadline:.3f}s before "
+                f"device pickup (swept from queue)"
+            ),
+        )
+
     def _resolve(self, req: Request, result=None,
                  exc: Optional[BaseException] = None) -> bool:
         """Resolve one request exactly once and retire it from the live
@@ -248,6 +349,7 @@ class ServingEngine:
             for r in batch_reqs:
                 if r.expired(now):
                     self.metrics.inc("expired")
+                    self.metrics.record_lane(r.lane, expired=True)
                     self._resolve(
                         r,
                         exc=DeadlineExceeded(
@@ -273,6 +375,7 @@ class ServingEngine:
         # released when this returns, unblocking the assembler
         t0 = time.monotonic()
         model = reqs[0].model
+        lane = reqs[0].lane
         # model kwarg only when the batch carries one (legacy runner
         # fakes keep their run(batch) signature)
         mkw = {} if model is None else {"model": model}
@@ -286,11 +389,12 @@ class ServingEngine:
             if self._routed:
                 # the pool retries/hedges/fails-over internally — the
                 # engine's own RetryPolicy would rerun an already-hedged
-                # batch; the tightest live deadline drives the hedge
+                # batch; the tightest live deadline drives the hedge,
+                # and the lane tag tightens it further for interactive
                 deadlines = [r.deadline for r in reqs if r.deadline is not None]
                 out = self.runner.run(
                     batch, deadline=min(deadlines) if deadlines else None,
-                    **mkw,
+                    lane=lane, **mkw,
                 )
             else:
                 out = self.retry.run(attempt_run)
@@ -299,17 +403,20 @@ class ServingEngine:
             for r in reqs:
                 if model is not None:
                     self.metrics.record_model(model, ok=False)
+                self.metrics.record_lane(r.lane, ok=False)
                 self._resolve(r, exc=e)
             return
         done = time.monotonic()
         self.metrics.service.record(done - t0)
         self.metrics.record_batch(len(reqs), self.runner.max_batch)
+        self.metrics.record_lane_batch(lane, len(reqs), self.runner.max_batch)
         for k, r in enumerate(reqs):
             # deadline re-check at completion: a request that expired
             # while its batch waited behind a slow/hedged predict must
             # report DeadlineExceeded, not a stale success
             if r.expired():
                 self.metrics.inc("expired")
+                self.metrics.record_lane(r.lane, expired=True)
                 self._resolve(
                     r,
                     exc=DeadlineExceeded(
@@ -325,13 +432,23 @@ class ServingEngine:
                 self.metrics.inc("failed")
                 if model is not None:
                     self.metrics.record_model(model, ok=False)
+                self.metrics.record_lane(r.lane, ok=False)
                 self._resolve(r, exc=e)
                 continue
+            if r.cache_key is not None and self.response_cache is not None:
+                # store only if the live version is STILL the one the key
+                # was minted against — a swap that landed mid-flight must
+                # not seed the cache with superseded-version results
+                if self._live_version(model) == r.cache_key[1]:
+                    self.response_cache.put(r.cache_key, dets)
             self.metrics.inc("completed")
             e2e_s = time.monotonic() - r.enqueue_t
             self.metrics.e2e.record(e2e_s)
             if model is not None:
                 self.metrics.record_model(model, e2e_s)
+            self.metrics.record_lane(
+                r.lane, e2e_s, queue_wait_s=r.picked_t - r.enqueue_t
+            )
             self._resolve(r, dets)
 
     # ----------------------------------------------------------- lifecycle
@@ -376,6 +493,12 @@ class ServingEngine:
     # ---------------------------------------------------------- reporting
     def snapshot(self) -> Dict:
         out = self.metrics.snapshot(self.runner.compile_cache)
+        out["scheduler"] = self.batcher.stats()
+        if self.response_cache is not None:
+            out["response_cache"] = self.response_cache.snapshot()
+        parity = getattr(self.runner, "parity", None)
+        if parity:
+            out["parity"] = dict(parity)
         if self._pool is not None:
             out["completion"] = self._pool.stats()
         if self._routed:

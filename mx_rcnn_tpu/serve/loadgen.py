@@ -47,6 +47,7 @@ def run_load(
     queue_full_backoff: float = 0.002,
     collect: bool = False,
     models: Optional[Sequence[str]] = None,
+    lanes: Optional[Sequence[Optional[str]]] = None,
 ) -> Dict:
     """Drive ``engine`` with ``num_requests`` synthetic images; returns a
     report dict (wall/throughput/outcome counts + the engine's metrics
@@ -58,6 +59,14 @@ def run_load(
     The draw happens from ``seed`` before any thread starts (same rng
     stream discipline as sizes), so the (index → model) mapping is
     identical across runs.
+
+    ``lanes`` (optional) does the same for SLO classes — each request's
+    lane is drawn from the sequence (``None`` entries mean "let the
+    engine default", i.e. the model's registry SLO class), producing a
+    deterministic mixed-lane stream.  Drawn AFTER sizes and models, so
+    adding lanes to an existing scenario leaves its size/model streams
+    unchanged.  Per-lane outcome counts land under
+    ``report["lane_outcomes"]``.
 
     ``collect=True`` additionally stores each request's resolution under
     ``report["_results"]`` — ``{index: ("ok", detections) | (kind, repr)}``
@@ -77,15 +86,26 @@ def run_load(
         [models[size_rng.randint(len(models))] for _ in range(num_requests)]
         if models else None
     )
+    req_lanes = (
+        [lanes[size_rng.randint(len(lanes))] for _ in range(num_requests)]
+        if lanes else None
+    )
     counter = iter(range(num_requests))
     lock = threading.Lock()
     outcomes = {"ok": 0, "deadline": 0, "error": 0, "queue_full_retries": 0}
+    lane_outcomes: Dict[str, Dict[str, int]] = {}
     results: Dict[int, Tuple[str, object]] = {}
     times: Dict[int, Tuple[float, float]] = {}
 
-    def note(key: str) -> None:
+    def note(key: str, lane: Optional[str] = None) -> None:
         with lock:
             outcomes[key] += 1
+            if lane is not None:
+                per = lane_outcomes.setdefault(
+                    lane, {"ok": 0, "deadline": 0, "error": 0}
+                )
+                if key in per:
+                    per[key] += 1
 
     def client() -> None:
         while True:
@@ -99,6 +119,9 @@ def run_load(
                 {} if req_models is None or req_models[i] is None
                 else {"model": req_models[i]}
             )
+            lane = req_lanes[i] if req_lanes is not None else None
+            if lane is not None:
+                mkw["lane"] = lane
             t_submit = time.monotonic()
             while True:
                 try:
@@ -109,13 +132,13 @@ def run_load(
                     time.sleep(queue_full_backoff)
             try:
                 dets = fut.result()
-                note("ok")
+                note("ok", lane)
                 if collect:
                     with lock:
                         results[i] = ("ok", dets)
             except Exception as e:
                 kind = "deadline" if "Deadline" in type(e).__name__ else "error"
-                note(kind)
+                note(kind, lane)
                 if collect:
                     with lock:
                         results[i] = (kind, repr(e))
@@ -147,6 +170,9 @@ def run_load(
     }
     if models:
         report["models"] = list(models)
+    if lanes:
+        report["lanes"] = list(lanes)
+        report["lane_outcomes"] = lane_outcomes
     if collect:
         report["_results"] = results
         report["_times"] = times

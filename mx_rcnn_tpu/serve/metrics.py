@@ -133,6 +133,10 @@ class ServeMetrics:
         # for requests that carried an explicit model id, so the
         # single-model deployment pays nothing and reports nothing extra
         self.by_model: Dict[str, Dict] = {}
+        # per-lane breakdown (SLO tiers): every request lands in exactly
+        # one lane ("bulk" when untagged), so lane histograms partition
+        # the aggregate ones above
+        self.by_lane: Dict[str, Dict] = {}
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -152,6 +156,42 @@ class ServeMetrics:
             m["completed" if ok else "failed"] += 1
         if ok and e2e_s is not None:
             m["e2e"].record(e2e_s)
+
+    def _lane(self, lane: str) -> Dict:
+        # caller holds self._lock
+        m = self.by_lane.get(lane)
+        if m is None:
+            m = self.by_lane[lane] = {
+                "completed": 0, "failed": 0, "expired": 0,
+                "batches": 0, "batch_real": 0, "batch_slots": 0,
+                "queue_wait": LatencyHistogram(), "e2e": LatencyHistogram(),
+            }
+        return m
+
+    def record_lane(self, lane: str, e2e_s: Optional[float] = None,
+                    queue_wait_s: Optional[float] = None,
+                    ok: bool = True, expired: bool = False) -> None:
+        """Per-lane completion/failure/expiry counters + latency
+        histograms — the SLO-tier evidence (a bulk backlog must not move
+        the interactive histogram)."""
+        with self._lock:
+            m = self._lane(lane)
+            if expired:
+                m["expired"] += 1
+            else:
+                m["completed" if ok else "failed"] += 1
+        if ok and not expired:
+            if e2e_s is not None:
+                m["e2e"].record(e2e_s)
+            if queue_wait_s is not None:
+                m["queue_wait"].record(queue_wait_s)
+
+    def record_lane_batch(self, lane: str, real: int, slots: int) -> None:
+        with self._lock:
+            m = self._lane(lane)
+            m["batches"] += 1
+            m["batch_real"] += real
+            m["batch_slots"] += slots
 
     def record_batch(self, real: int, slots: int) -> None:
         with self._lock:
@@ -207,6 +247,7 @@ class ServeMetrics:
         }
         with self._lock:
             by_model = dict(self.by_model)
+            by_lane = dict(self.by_lane)
         if by_model:
             out["models"] = {
                 mid: {
@@ -215,6 +256,22 @@ class ServeMetrics:
                     "e2e": m["e2e"].snapshot(),
                 }
                 for mid, m in by_model.items()
+            }
+        if by_lane:
+            out["lanes"] = {
+                lane: {
+                    "completed": m["completed"],
+                    "failed": m["failed"],
+                    "expired": m["expired"],
+                    "batches": m["batches"],
+                    "occupancy": (
+                        round(m["batch_real"] / m["batch_slots"], 4)
+                        if m["batch_slots"] else None
+                    ),
+                    "queue_wait": m["queue_wait"].snapshot(),
+                    "e2e": m["e2e"].snapshot(),
+                }
+                for lane, m in by_lane.items()
             }
         if compile_cache is not None:
             out["compile"] = compile_cache.snapshot()

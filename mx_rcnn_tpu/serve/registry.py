@@ -154,12 +154,16 @@ class ModelVersion:
 
 class _Entry:
     """Registry row for one model family: the (stateless) flax module,
-    its config, and the version history with a live pointer."""
+    its config, the version history with a live pointer, and the
+    family's default SLO lane (requests without an explicit lane tag
+    inherit it — an interactive-tier model taints its traffic)."""
 
-    def __init__(self, model_id: str, model: Any, cfg: Any):
+    def __init__(self, model_id: str, model: Any, cfg: Any,
+                 slo_class: str = "bulk"):
         self.model_id = model_id
         self.model = model
         self.cfg = cfg
+        self.slo_class = slo_class
         self.versions: List[ModelVersion] = []
         self.live: Optional[ModelVersion] = None
         self.next_version = 1
@@ -179,6 +183,10 @@ class ModelRegistry:
         self.swaps_rolled_back = 0
         self.swaps_cancelled = 0
         self.versions_released = 0
+        # live-pointer-moved listeners (response-cache invalidation):
+        # called OUTSIDE the registry lock — listeners take their own
+        # leaf locks and must never re-enter the registry
+        self._live_listeners: List[Any] = []
 
     # ----------------------------------------------------------- versions
     def _transition(
@@ -222,15 +230,24 @@ class ModelRegistry:
         params: Any,
         digest: Optional[str] = None,
         source: str = "init",
+        slo_class: str = "bulk",
     ) -> ModelVersion:
         """Add a model family with its v1 params (already loaded and
         trusted by the caller — the CLI verifies checkpoint sources
         before registering).  v1 goes straight to LIVE; later versions
-        arrive only through :meth:`swap` and walk the full gate."""
+        arrive only through :meth:`swap` and walk the full gate.
+        ``slo_class`` ("interactive" | "bulk") is the lane requests for
+        this family default into when they carry no lane of their own."""
+        from mx_rcnn_tpu.serve.batcher import LANES
+
+        if slo_class not in LANES:
+            raise RegistryError(
+                f"slo_class must be one of {LANES}, got {slo_class!r}"
+            )
         with self._lock:
             if model_id in self._entries:
                 raise RegistryError(f"model {model_id!r} already registered")
-            e = _Entry(model_id, model, cfg)
+            e = _Entry(model_id, model, cfg, slo_class=slo_class)
             v = ModelVersion(
                 model_id, e.next_version, params=params, digest=digest,
                 source=source, state=VersionState.LOADING,
@@ -275,6 +292,36 @@ class ModelRegistry:
             if e.live is None:
                 raise RegistryError(f"model {e.model_id!r} has no live version")
             return e.live
+
+    def slo_class(self, model_id: Optional[str] = None) -> str:
+        """The lane a request for ``model_id`` defaults into when it
+        carries no explicit lane tag (the engine consults this on
+        submit)."""
+        with self._lock:
+            return self.entry(model_id).slo_class
+
+    # --------------------------------------------- live-change listeners
+    def subscribe_live(self, callback: Any) -> None:
+        """Register ``callback(model_id)`` to fire whenever a model's
+        live pointer moves — swap commit, canary rollback, or cancel
+        rollback.  The serving engine wires its response cache's
+        ``invalidate_model`` here, so a hot-swap can never leave cached
+        responses from a superseded version behind."""
+        with self._lock:
+            self._live_listeners.append(callback)
+
+    def _notify_live(self, model_id: str) -> None:
+        """Fan the live-pointer movement out to listeners.  Called
+        OUTSIDE the registry lock (listeners take their own leaf locks);
+        a listener error is logged, never propagated — invalidation is
+        hygiene, not a swap gate."""
+        with self._lock:
+            listeners = list(self._live_listeners)
+        for cb in listeners:
+            try:
+                cb(model_id)
+            except Exception:  # noqa: BLE001 — hygiene, not a gate
+                logger.exception("live-change listener failed for %s", model_id)
 
     # -------------------------------------------------------------- swaps
     def swap(
@@ -332,6 +379,7 @@ class ModelRegistry:
             models = {
                 mid: {
                     "live_version": e.live.version if e.live else None,
+                    "slo_class": e.slo_class,
                     "versions": [v.snapshot() for v in e.versions],
                     "swap_in_flight": (
                         mid in self._swaps and not self._swaps[mid].done()
@@ -482,6 +530,7 @@ class SwapController:
                 self._abort_check()
                 reg._transition(ver, VersionState.LIVE, "swap commit")
                 e.live = ver
+            reg._notify_live(e.model_id)  # cached v(old) responses: out
 
             # canary: live-path probes; failure rolls the pointer back
             stage = "canary"
@@ -491,6 +540,7 @@ class SwapController:
             except Exception as ce:
                 with reg._lock:
                     e.live = old
+                reg._notify_live(e.model_id)
                 reg._retire(ver, f"canary failed — rolled back: {ce!r}")
                 self._discard(ver)
                 with reg._lock:
@@ -533,9 +583,13 @@ class SwapController:
         """Retire a candidate that failed before (or during) commit; if
         the live pointer already moved to it, point back at ``old``."""
         reg = self.registry
+        moved = False
         with reg._lock:
             if self.entry.live is ver:
                 self.entry.live = old
+                moved = True
+        if moved:
+            reg._notify_live(self.entry.model_id)
         reg._retire(ver, reason)
 
     def _discard(self, ver: ModelVersion) -> None:

@@ -132,6 +132,7 @@ class _Dispatch:
     future: Future = field(default_factory=Future)
     ordinal: int = -1    # per-replica batch ordinal, set at predict time
     model: Optional[str] = None  # registry model id (None = default)
+    lane: Optional[str] = None   # SLO class tag (observability only)
 
     def resolve(self, result=None, exc: Optional[BaseException] = None) -> bool:
         """Set the future if still unset; False when it already resolved
@@ -233,12 +234,13 @@ class Replica:
         batch: Dict[str, np.ndarray],
         deadline: Optional[float] = None,
         model: Optional[str] = None,
+        lane: Optional[str] = None,
     ) -> _Dispatch:
         """Enqueue one batch; returns the dispatch whose future resolves
         exactly once.  A non-routable replica fails it immediately with
         :class:`ReplicaDrained` instead of accepting work it would only
         drain later."""
-        d = _Dispatch(batch=batch, deadline=deadline, model=model)
+        d = _Dispatch(batch=batch, deadline=deadline, model=model, lane=lane)
         with self._lock:
             if self._stop or self.state not in (
                 ReplicaState.HEALTHY, ReplicaState.DEGRADED

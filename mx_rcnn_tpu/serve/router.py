@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from mx_rcnn_tpu.analysis.lockcheck import make_lock
+from mx_rcnn_tpu.serve.batcher import LANES
 from mx_rcnn_tpu.serve.metrics import LatencyHistogram
 from mx_rcnn_tpu.serve.replica import (
     HealthPolicy,
@@ -103,6 +104,7 @@ class ReplicaPool:
         hedge_timeout: float = 2.0,
         min_hedge_timeout: float = 0.05,
         no_healthy_wait: float = 0.5,
+        interactive_hedge_factor: float = 0.5,
     ):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
@@ -110,6 +112,10 @@ class ReplicaPool:
         self.hedge_timeout = float(hedge_timeout)
         self.min_hedge_timeout = float(min_hedge_timeout)
         self.no_healthy_wait = float(no_healthy_wait)
+        # interactive batches hedge this much sooner: a straggler replica
+        # costs an interactive request its SLO long before it costs a
+        # bulk batch anything, so the latency-tier pays for redundancy
+        self.interactive_hedge_factor = float(interactive_hedge_factor)
         self.replicas: List[Replica] = [
             Replica(i, runner_factory, policy=self.policy)
             for i in range(n_replicas)
@@ -123,6 +129,7 @@ class ReplicaPool:
         self.hedge_wins = 0
         self.failovers = 0
         self.no_healthy = 0
+        self.dispatched_by_lane = {lane: 0 for lane in LANES}
         self.service = LatencyHistogram()  # per-batch, routing included
 
     # ------------------------------------------------- runner facade
@@ -264,30 +271,39 @@ class ReplicaPool:
                 best, best_key = r, key
         return best
 
-    def _hedge_s(self, deadline: Optional[float]) -> float:
+    def _hedge_s(
+        self, deadline: Optional[float], lane: Optional[str] = None
+    ) -> float:
         """Half the remaining deadline budget, clamped into
         [min_hedge_timeout, hedge_timeout] — a tight deadline hedges
-        sooner, no deadline uses the configured default."""
+        sooner, no deadline uses the configured default.  Interactive
+        batches scale the result by ``interactive_hedge_factor``."""
         if deadline is None:
-            return self.hedge_timeout
-        remaining = deadline - time.monotonic()
-        return min(
-            self.hedge_timeout,
-            max(self.min_hedge_timeout, remaining * 0.5),
-        )
+            s = self.hedge_timeout
+        else:
+            remaining = deadline - time.monotonic()
+            s = min(
+                self.hedge_timeout,
+                max(self.min_hedge_timeout, remaining * 0.5),
+            )
+        if lane == "interactive":
+            s = max(self.min_hedge_timeout, s * self.interactive_hedge_factor)
+        return s
 
     def run(
         self,
         batch: Dict[str, np.ndarray],
         deadline: Optional[float] = None,
         model: Optional[str] = None,
+        lane: Optional[str] = None,
     ) -> Dict[str, np.ndarray]:
         """Predict ``batch`` on some healthy replica: least-loaded pick,
         hedge past the timeout, requeue on drain, fail over on error.
         ``model`` keys the affinity and rides the dispatch down to the
-        replica's runner.  Raises :class:`NoHealthyReplica` when the
-        pool has no capacity, or the last replica error after bounded
-        failover."""
+        replica's runner; ``lane`` tightens the hedge for interactive
+        batches and feeds per-lane dispatch counters.  Raises
+        :class:`NoHealthyReplica` when the pool has no capacity, or the
+        last replica error after bounded failover."""
         bucket = tuple(batch["images"].shape[1:3])
         t0 = time.monotonic()
         attempts = 0
@@ -313,9 +329,11 @@ class ReplicaPool:
                 ) from last_exc
             with self._lock:
                 self.dispatched += 1
-            d = primary.submit(batch, deadline, model=model)
+                if lane in self.dispatched_by_lane:
+                    self.dispatched_by_lane[lane] += 1
+            d = primary.submit(batch, deadline, model=model, lane=lane)
             try:
-                out = d.future.result(timeout=self._hedge_s(deadline))
+                out = d.future.result(timeout=self._hedge_s(deadline, lane))
                 self._done(t0)
                 return out
             except ReplicaDrained as e:
@@ -325,7 +343,8 @@ class ReplicaPool:
                 continue  # replica tripped mid-flight: requeue elsewhere
             except FutureTimeout:
                 out = self._race_hedge(
-                    batch, bucket, deadline, primary, d, model=model
+                    batch, bucket, deadline, primary, d, model=model,
+                    lane=lane,
                 )
                 if out is not None:
                     self._done(t0)
@@ -356,7 +375,8 @@ class ReplicaPool:
                 return r
         return None
 
-    def _race_hedge(self, batch, bucket, deadline, primary, d, model=None):
+    def _race_hedge(self, batch, bucket, deadline, primary, d, model=None,
+                    lane=None):
         """Primary exceeded the hedge timeout: dispatch the same batch to
         a second replica and race.  Returns the first success, or None
         when both legs fail.  The losing leg's result is discarded by its
@@ -370,7 +390,7 @@ class ReplicaPool:
                 return d.future.result()
             except Exception:  # noqa: BLE001
                 return None
-        d2 = backup.submit(batch, deadline, model=model)
+        d2 = backup.submit(batch, deadline, model=model, lane=lane)
         futures = {d.future: "primary", d2.future: "hedge"}
         while futures:
             done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
@@ -411,6 +431,7 @@ class ReplicaPool:
                 "hedge_wins": self.hedge_wins,
                 "failovers": self.failovers,
                 "no_healthy": self.no_healthy,
+                "dispatched_by_lane": dict(self.dispatched_by_lane),
             }
         out = {
             "replicas": per,

@@ -22,9 +22,10 @@ ladder once, after which ``misses`` must stay 0.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 
 import jax
 import numpy as np
@@ -43,6 +44,84 @@ from mx_rcnn_tpu.serve.batcher import Request
 from mx_rcnn_tpu.serve.buckets import BucketLadder, CompileCache
 
 ClsDets = List[Optional[np.ndarray]]  # [None, (n1, 5), ..., (nK-1, 5)]
+
+#: compile-cache precision tags — part of every jit signature, so the
+#: f32 and bf16 serve graphs can never collide on one cache key
+_PRECISION_TAGS = {
+    None: "f32", "float32": "f32", "f32": "f32",
+    "bfloat16": "bf16", "bf16": "bf16",
+}
+
+
+class PrecisionParityError(RuntimeError):
+    """The bf16 serve graph's detections drifted outside the documented
+    tolerance vs the f32 reference — the precision mode refuses to
+    serve (fail at warmup, not in production results)."""
+
+
+def _box_iou(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(n, 4) × (m, 4) [x1 y1 x2 y2] → (n, m) IoU matrix."""
+    ax1, ay1, ax2, ay2 = [a[:, k, None] for k in range(4)]
+    bx1, by1, bx2, by2 = [b[None, :, k] for k in range(4)]
+    iw = np.maximum(np.minimum(ax2, bx2) - np.maximum(ax1, bx1) + 1.0, 0.0)
+    ih = np.maximum(np.minimum(ay2, by2) - np.maximum(ay1, by1) + 1.0, 0.0)
+    inter = iw * ih
+    area_a = (ax2 - ax1 + 1.0) * (ay2 - ay1 + 1.0)
+    area_b = (bx2 - bx1 + 1.0) * (by2 - by1 + 1.0)
+    return inter / np.maximum(area_a + area_b - inter, 1e-9)
+
+
+def detection_parity(
+    ref: ClsDets,
+    test: ClsDets,
+    thresh: float,
+    margin: float = 0.1,
+    match_iou: float = 0.5,
+) -> Dict:
+    """Compare two detection sets for reduced-precision parity.
+
+    Detections scoring within ``margin`` of ``thresh`` are exempt —
+    threshold flips are the expected (and harmless) failure mode of a
+    lower-precision graph.  Every CONFIDENT detection (score ≥ thresh +
+    margin) on either side must have a counterpart on the other with
+    IoU ≥ ``match_iou``; for matched pairs the max absolute box-corner
+    delta (px) and score delta are reported.  Symmetric by construction.
+    """
+    max_box = 0.0
+    max_score = 0.0
+    unmatched = 0
+    for j in range(1, max(len(ref), len(test))):
+        a = ref[j] if j < len(ref) else None
+        b = test[j] if j < len(test) else None
+        a = np.zeros((0, 5), np.float32) if a is None else np.asarray(a)
+        b = np.zeros((0, 5), np.float32) if b is None else np.asarray(b)
+        for src, dst in ((a, b), (b, a)):
+            conf = src[src[:, 4] >= thresh + margin]
+            if not len(conf):
+                continue
+            if not len(dst):
+                unmatched += len(conf)
+                continue
+            iou = _box_iou(conf[:, :4], dst[:, :4])
+            best = iou.argmax(axis=1)
+            for i, k in enumerate(best):
+                if iou[i, k] < match_iou:
+                    unmatched += 1
+                    continue
+                max_box = max(
+                    max_box,
+                    float(np.abs(conf[i, :4] - dst[k, :4]).max()),
+                )
+                max_score = max(
+                    max_score, float(abs(conf[i, 4] - dst[k, 4]))
+                )
+    return {
+        "max_box_delta_px": round(max_box, 4),
+        "max_score_delta": round(max_score, 5),
+        "unmatched_confident": unmatched,
+        "margin": margin,
+        "match_iou": match_iou,
+    }
 
 
 # --------------------------------------------------------------- detections
@@ -163,13 +242,14 @@ class _ModelSlot:
     lands cleanly BETWEEN batches."""
 
     def __init__(self, model_id, predictor, version, cfg, num_classes,
-                 uint8: bool):
+                 uint8: bool, precision: str = "f32"):
         self.model_id = model_id
         self.predictor = predictor
         self.version = int(version)
         self.cfg = cfg
         self.num_classes = int(num_classes)
         self.uint8 = bool(uint8)
+        self.precision = precision  # compile-cache tag: "f32" | "bf16"
         self.lock = make_lock("_ModelSlot.lock")
 
 
@@ -214,6 +294,11 @@ class ServeRunner:
         layout_feed: Optional[bool] = None,
         registry=None,
         device=None,
+        precision: Optional[Union[str, Dict[str, str]]] = None,
+        parity_check: bool = True,
+        parity_box_tol: float = 4.0,
+        parity_score_tol: float = 0.1,
+        parity_margin: float = 0.1,
     ):
         from mx_rcnn_tpu.serve.registry import DEFAULT_MODEL, ModelRegistry
 
@@ -257,6 +342,14 @@ class ServeRunner:
         self._layouts: Dict[Tuple, object] = {}  # warmup-captured, per sig
         self.staged_batches = 0
         self.layout_staged = 0
+        # serve-graph precision (opt-in bf16, see _slot): a global
+        # string applies to every model, a dict assigns per model
+        self._precision = precision
+        self._parity_check = bool(parity_check)
+        self._parity_box_tol = float(parity_box_tol)
+        self._parity_score_tol = float(parity_score_tol)
+        self._parity_margin = float(parity_margin)
+        self.parity: Dict[str, Dict] = {}  # model → last gate report
         # registry-resolution state
         self._slots: Dict[str, _ModelSlot] = {}
         self._slots_lock = make_lock("ServeRunner._slots_lock")
@@ -275,6 +368,16 @@ class ServeRunner:
             return tree
         return jax.device_put(tree, self.device)
 
+    def _precision_for(self, model_id: str) -> str:
+        """Compile-cache precision tag for ``model_id`` ("f32"/"bf16")."""
+        p = self._precision
+        if isinstance(p, dict):
+            p = p.get(model_id)
+        tag = _PRECISION_TAGS.get(p)
+        if tag is None:
+            raise ValueError(f"unknown serve precision {p!r}")
+        return tag
+
     def _slot(self, model_id: str) -> _ModelSlot:
         s = self._slots.get(model_id)
         if s is not None:
@@ -286,6 +389,25 @@ class ServeRunner:
             e = self.registry.entry(model_id)
             live = self.registry.live(model_id)
             cfg = e.cfg
+            serve_model = e.model
+            precision = self._precision_for(model_id)
+            if precision == "bf16":
+                # the inference-optimized serve graph: compute dtype is
+                # baked into the flax module at build time, so the slot
+                # gets a REBUILT module at bf16 with the BN affine
+                # folded into conv weights (fused_conv_bn — param paths
+                # identical, so the registry's f32 params apply as-is
+                # and hot-swap structure checks stay valid)
+                from mx_rcnn_tpu.models import build_model
+
+                cfg = cfg.replace(
+                    network=dataclasses.replace(
+                        cfg.network,
+                        COMPUTE_DTYPE="bfloat16",
+                        FOLD_BN=True,
+                    )
+                )
+                serve_model = build_model(cfg)
             if (
                 model_id == self.default_model
                 and self._num_classes_override is not None
@@ -310,12 +432,12 @@ class ServeRunner:
             # making cross-bucket detections bitwise identical (Predictor
             # docstring); fast mode agrees to ~1e-5 px on box coordinates
             predictor = Predictor(
-                e.model, self._place(live.params), postprocess=post,
+                serve_model, self._place(live.params), postprocess=post,
                 donate=self._donate, deterministic=self._deterministic,
             )
             s = _ModelSlot(
                 model_id, predictor, live.version, cfg, n_cls,
-                bool(cfg.TEST.UINT8_TRANSFER),
+                bool(cfg.TEST.UINT8_TRANSFER), precision=precision,
             )
             self._slots[model_id] = s
             return s
@@ -396,10 +518,14 @@ class ServeRunner:
     def _signature(
         self, batch: Dict[str, np.ndarray], model: Optional[str] = None
     ) -> Tuple:
+        mid = self.default_model if model is None else model
         return (
-            self.default_model if model is None else model,
+            mid,
             batch["images"].shape,
             str(batch["images"].dtype),
+            # precision is part of the key: an f32 and a bf16 serve
+            # graph for the same (model, shape) are different programs
+            self._precision_for(mid),
         )
 
     def stage(
@@ -493,7 +619,106 @@ class ServeRunner:
                     layouts = slot.predictor.input_layouts(batch)
                     if layouts is not None:
                         self._layouts[self._signature(batch, mid)] = layouts
+            if (
+                slot.precision == "bf16"
+                and self._parity_check
+                and mid not in self.parity
+            ):
+                self.check_parity(mid)
         return self.compile_cache.misses
+
+    # ---- serve-graph precision parity gate
+    def _parity_batch(self, mid: str, bucket: Tuple[int, int]) -> Dict:
+        """Deterministic noise probe batch (zeros would make the parity
+        comparison vacuous — no proposals clear the score threshold)."""
+        bh, bw = bucket
+        slot = self._slot(mid)
+        rng = np.random.RandomState(0)
+        im = rng.randint(0, 256, (bh, bw, 3)).astype(
+            np.uint8 if slot.uint8 else np.float32
+        )
+        req = Request(
+            image=im,
+            im_info=np.array([bh, bw, 1.0], np.float32),
+            orig_hw=(bh, bw),
+            bucket=(bh, bw),
+            model=None if mid == self.default_model else mid,
+        )
+        return self.assemble([req])
+
+    def check_parity(
+        self,
+        model: Optional[str] = None,
+        bucket: Optional[Tuple[int, int]] = None,
+    ) -> Dict:
+        """Gate a bf16 serve graph on detection parity vs the f32 path.
+
+        Runs one deterministic probe batch (smallest ladder rung unless
+        ``bucket`` overrides) through the model's bf16 slot AND a
+        transient f32 reference predictor built from the registered
+        module + live params, then compares detections with
+        :func:`detection_parity`.  Outside the documented tolerance →
+        :class:`PrecisionParityError`, so a drifting precision config
+        fails at warmup, never in production results.  The f32 reference
+        is a one-shot compile OFF the serving path — it is deliberately
+        not recorded in the compile cache, whose signatures account the
+        programs that serve traffic.  The report lands in
+        ``self.parity[model]`` and engine/bench snapshots."""
+        mid = self.default_model if model is None else model
+        slot = self._slot(mid)
+        if slot.precision != "bf16":
+            report = {"precision": slot.precision, "checked": False}
+            self.parity[mid] = report
+            return report
+        bucket = tuple(bucket) if bucket else next(iter(self.ladder))
+        batch = self._parity_batch(mid, bucket)
+        e = self.registry.entry(mid)
+        live = self.registry.live(mid)
+        self._sync(slot)
+        out_bf16 = slot.predictor.predict(batch)
+        # mirror the slot's postprocess flavor (visible in its output
+        # keys) so parity measures PRECISION, not device-vs-host NMS
+        post = None
+        if "det_boxes" in out_bf16:
+            from mx_rcnn_tpu.ops.postprocess import make_test_postprocess
+
+            post = make_test_postprocess(
+                e.cfg, slot.num_classes, e.cfg.TEST.SCORE_THRESH,
+                max_out=e.cfg.TEST.DET_PER_CLASS,
+            )
+        ref_predictor = Predictor(
+            e.model, self._place(live.params), postprocess=post,
+            donate=False, deterministic=self._deterministic,
+        )
+        out_f32 = ref_predictor.predict(batch)
+        thresh = float(slot.cfg.TEST.SCORE_THRESH)
+        dets_bf16 = self.detections_for(out_bf16, batch, 0, model=model)
+        ref_dets, _ = detections_from_output(
+            out_f32, batch["im_info"][0], tuple(batch["orig_hw"][0]),
+            e.cfg, slot.num_classes,
+        )
+        ref_dets, _ = cap_detections(ref_dets, e.cfg.TEST.MAX_PER_IMAGE)
+        report = detection_parity(
+            ref_dets, dets_bf16, thresh, margin=self._parity_margin
+        )
+        report.update(
+            precision="bf16", checked=True, bucket=list(bucket),
+            box_tol_px=self._parity_box_tol,
+            score_tol=self._parity_score_tol,
+        )
+        ok = (
+            report["unmatched_confident"] == 0
+            and report["max_box_delta_px"] <= self._parity_box_tol
+            and report["max_score_delta"] <= self._parity_score_tol
+        )
+        report["ok"] = ok
+        self.parity[mid] = report
+        if not ok:
+            raise PrecisionParityError(
+                f"bf16 serve graph for model {mid!r} outside parity "
+                f"tolerance vs f32: {report}"
+            )
+        return report
 
     # ---- hot-swap (SwapController target surface)
     def warm_version(

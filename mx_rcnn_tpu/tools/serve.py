@@ -137,6 +137,23 @@ def main():
     p.add_argument("--concurrency", type=int, default=8)
     p.add_argument("--deadline_ms", type=float, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--lane_mix", type=int, default=0, metavar="N",
+                   help="tag one in N requests interactive (two-lane SLO "
+                   "scheduling); 0 = untagged single-lane traffic")
+    p.add_argument("--interactive_linger_ms", type=float, default=0.0,
+                   help="linger for the interactive lane (default 0: "
+                   "dispatch the moment a device slot frees)")
+    p.add_argument("--bulk_age_limit", type=float, default=2.0,
+                   help="seconds a bulk batch may wait before it takes "
+                   "the next slot unconditionally (anti-starvation)")
+    p.add_argument("--precision", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="serve-graph compute dtype; bfloat16 also folds "
+                   "BN and is parity-gated against f32 at warmup")
+    p.add_argument("--response_cache", type=int, default=0, metavar="N",
+                   help="idempotent response cache capacity (entries); "
+                   "0 disables.  Keyed by image digest per (model, "
+                   "version), invalidated on hot-swap")
     p.add_argument("--model", action="append", default=[],
                    metavar="NAME=[network:]SRC",
                    help="register an extra model family (repeatable); SRC "
@@ -184,23 +201,35 @@ def main():
             load_models.append(name)
             logger.info("registered model %r from %s", name, src)
 
+    precision = None if args.precision == "float32" else args.precision
     if args.replicas > 1 or args.force_pool:
         from mx_rcnn_tpu.serve.router import ReplicaPool, make_replica_factory
 
         factory = make_replica_factory(
             lambda registry, device: ServeRunner(
-                registry=registry, device=device, max_batch=args.max_batch
+                registry=registry, device=device, max_batch=args.max_batch,
+                precision=precision,
             ),
             registry=registry,
         )
         runner = ReplicaPool(factory, n_replicas=args.replicas)
     else:
-        runner = ServeRunner(registry=registry, max_batch=args.max_batch)
+        runner = ServeRunner(
+            registry=registry, max_batch=args.max_batch, precision=precision
+        )
+    response_cache = None
+    if args.response_cache > 0:
+        from mx_rcnn_tpu.serve.respcache import ResponseCache
+
+        response_cache = ResponseCache(capacity=args.response_cache)
     engine = ServingEngine(
         runner,
         max_linger=args.linger_ms / 1000.0,
         max_queue=args.max_queue,
         in_flight=args.in_flight,
+        interactive_linger=args.interactive_linger_ms / 1000.0,
+        bulk_age_limit=args.bulk_age_limit,
+        response_cache=response_cache,
     )
     logger.info(
         "warming up %d bucket(s) x %d model(s) x %d replica(s)...",
@@ -223,6 +252,12 @@ def main():
         except Exception as e:  # noqa: BLE001 — report it, don't kill the load
             swap_result.update(error=repr(e))
 
+    # --lane_mix N: a lane menu with one "interactive" per N-1 untagged
+    # entries — run_load draws uniformly, so ~1/N of requests jump lanes
+    load_lanes = None
+    if args.lane_mix > 0:
+        load_lanes = ["interactive"] + [None] * max(1, args.lane_mix - 1)
+
     with engine:
         swapper = None
         if args.swap:
@@ -239,6 +274,7 @@ def main():
                 if args.deadline_ms is not None else None
             ),
             models=load_models,
+            lanes=load_lanes,
         )
         if swapper is not None:
             swapper.join()
