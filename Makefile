@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-fault swap slo pipeline elastic chaos integration-gate clean-native
+.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-fault swap slo poison pipeline elastic chaos integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -112,6 +112,18 @@ swap:
 slo:
 	JAX_PLATFORMS=cpu $(PY) bench.py --slo --out BENCH_serve_slo_cpu.json
 
+# query-of-death containment bench (ISSUE 12): ~5% deterministic poison
+# (per-size qod_image digests wired to poison_fail) inside healthy
+# traffic on a 2-replica pool with the quarantine table on; proves zero
+# healthy losses, healthy detections byte-identical to the unfaulted
+# run, every poison digest quarantined within <=K trips, and all
+# replicas HEALTHY at the end; emits JSON lines + the
+# BENCH_poison_cpu.json artifact
+poison:
+	JAX_PLATFORMS=cpu $(PY) bench.py --poison --serve_requests 48 \
+	      --serve_concurrency 6 --serve_max_batch 2 --serve_replicas 2 \
+	      --out BENCH_poison_cpu.json
+
 # device-resident step pipeline bench (ISSUE 4): feed occupancy, fetch
 # stalls, K=1 byte-identical check on the CPU smoke config; emits JSON
 # lines + the BENCH_pipeline.json artifact
@@ -129,13 +141,15 @@ pipeline:
 elastic:
 	$(PY) bench.py --elastic --out BENCH_elastic_cpu.json
 
-# chaos gate (ISSUE 9): every deterministic fault-injection surface in
-# one target — the elastic loop's unit matrix plus the preemption and
-# resilience suites, with the lock-order checker armed
+# chaos gate (ISSUE 9 + 12): every deterministic fault-injection
+# surface in one target — the elastic loop's unit matrix plus the
+# preemption, resilience, and query-of-death quarantine suites, with
+# the lock-order checker armed — then the poison containment bench
 chaos:
 	JAX_PLATFORMS=cpu MX_RCNN_LOCK_CHECK=1 $(PY) -m pytest \
 	      tests/test_elastic.py tests/test_preemption.py \
-	      tests/test_resilience.py -q
+	      tests/test_resilience.py tests/test_quarantine.py -q
+	$(MAKE) poison
 
 # train→eval mAP gates on synthetic data, one per model family
 # (VERDICT r3 #7): C4 flagship shape, FPN, Mask (polygon gts + segm

@@ -708,6 +708,183 @@ def _dets_equal(a, b) -> bool:
     )
 
 
+def bench_poison(
+    network: str,
+    requests: int,
+    concurrency: int,
+    max_batch: int,
+    linger_ms: float,
+    replicas: int = 2,
+    k: int = 2,
+    small: bool = True,
+) -> tuple:
+    """Query-of-death containment bench (ISSUE 12 acceptance evidence).
+
+    A 2-replica pool serves a deterministic mix of ~5% well-formed
+    poison (the per-size :func:`qod_image`, whose digests the fault spec
+    wires to ``poison_fail``) inside healthy traffic.  One clean run
+    (no faults, no quarantine) provides the byte-identity baseline; the
+    poisoned run must then show the four containment claims:
+
+    * zero healthy losses — every non-poison request resolves ok;
+    * healthy detections byte-identical to the unfaulted run;
+    * every poison digest quarantined after <= K independent trips
+      (global trip count bounded by ``digests * (k + 1)``, the +1
+      absorbing a concurrent-trip race across replicas);
+    * all replicas HEALTHY at the end — the pool outlives the poison.
+    """
+    import os
+
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.loadgen import qod_image, run_load
+    from mx_rcnn_tpu.serve.quarantine import QuarantineTable, request_digest
+    from mx_rcnn_tpu.serve.replica import HealthPolicy
+    from mx_rcnn_tpu.serve.router import ReplicaPool
+    from mx_rcnn_tpu.utils import faults
+
+    replicas = max(2, replicas)
+    seed = 0
+    mix = [None] * 17 + ["qod"]  # ~5% poison
+    _, _, _, sizes, factory = _serve_model(
+        network, small, max_batch, deterministic=True
+    )
+    # fail_threshold=1: a single predict failure trips the replica, so
+    # every poison execution becomes an attributable trip — the regime
+    # the K-trip quarantine bound is stated against (the default lenient
+    # threshold lets interleaved healthy successes reset the consecutive
+    # count and a qod then burns retry budget without ever tripping)
+    policy = HealthPolicy(stall_timeout=6.0, fail_threshold=1,
+                          breaker_backoff=0.25, breaker_max_backoff=4.0)
+
+    # replicate run_load's rng discipline (sizes then poison, no models/
+    # lanes) to learn which sizes the poisoned indices land on — that is
+    # the set of digests the fault spec must target
+    rng = np.random.RandomState(seed)
+    req_sizes = [sizes[rng.randint(len(sizes))] for _ in range(requests)]
+    req_poison = [mix[rng.randint(len(mix))] for _ in range(requests)]
+    healthy_idx = [i for i, fl in enumerate(req_poison) if fl is None]
+    digests = sorted({
+        request_digest(qod_image(h, w, seed))
+        for (h, w), fl in zip(req_sizes, req_poison) if fl == "qod"
+    })
+    spec = ",".join(f"poison_fail@{d[:12]}" for d in digests)
+
+    def one_run(poisoned: bool):
+        if poisoned:
+            os.environ[faults.ENV_VAR] = spec
+        else:
+            os.environ.pop(faults.ENV_VAR, None)
+        faults.reset()
+        qt = QuarantineTable(k=k, ttl_s=600.0) if poisoned else None
+        # budget x no_healthy_wait is the pool-outage tolerance: with 2
+        # replicas and fail_threshold=1 both can be rewarming at once (a
+        # full ladder recompile on CPU), and a healthy request spends one
+        # resubmit per NoHealthyReplica lap — 32 laps x 5 s outlasts the
+        # worst dual-rewarm window while still bounding a true qod to a
+        # handful of spends before quarantine ends its circulation
+        pool = ReplicaPool(
+            factory, n_replicas=replicas, policy=policy,
+            hedge_timeout=3.0, no_healthy_wait=5.0, quarantine=qt,
+        )
+        engine = ServingEngine(
+            pool, max_linger=linger_ms / 1000.0, in_flight=replicas,
+            retry_budget=32,
+        )
+        with engine:
+            report = run_load(
+                engine, num_requests=requests, concurrency=concurrency,
+                sizes=sizes, seed=seed, collect=True, poison_mix=mix,
+            )
+        if poisoned:
+            # wait out the tripped replicas' drain->rewarm->rejoin so
+            # "all replicas healthy" is measured, not raced
+            t_wait = time.time()
+            while time.time() - t_wait < 120.0:
+                reps = pool.snapshot()["replicas"]
+                if all(r["state"] == "healthy" for r in reps):
+                    break
+                time.sleep(0.5)
+        pool_snap = pool.snapshot()
+        pool.close()
+        return report, pool_snap, (qt.snapshot() if qt else None)
+
+    prior = os.environ.get(faults.ENV_VAR)
+    try:
+        base_report, _, _ = one_run(poisoned=False)
+        poi_report, pool_snap, q_snap = one_run(poisoned=True)
+    finally:
+        if prior is None:
+            os.environ.pop(faults.ENV_VAR, None)
+        else:
+            os.environ[faults.ENV_VAR] = prior
+        faults.reset()
+
+    base_res = base_report.pop("_results")
+    poi_res = poi_report.pop("_results")
+    base_report.pop("_times", None)
+    poi_report.pop("_times", None)
+
+    healthy_lost = sum(
+        1 for i in healthy_idx if poi_res.get(i, ("lost",))[0] != "ok"
+    )
+    byte_identical = all(
+        poi_res.get(i, ("lost",))[0] == "ok"
+        and base_res.get(i, ("lost",))[0] == "ok"
+        and _dets_equal(base_res[i][1], poi_res[i][1])
+        for i in healthy_idx
+    )
+    all_healthy = all(
+        r["state"] == "healthy" for r in pool_snap["replicas"]
+    )
+    quarantined = set(q_snap["quarantined"])
+    within_k = (
+        all(d[:12] in quarantined for d in digests)
+        and q_snap["trips"] <= len(digests) * (k + 1)
+    )
+    claims = {
+        "zero_healthy_lost": healthy_lost == 0,
+        "healthy_byte_identical": byte_identical,
+        "poison_quarantined_within_k": within_k,
+        "all_replicas_healthy": all_healthy,
+    }
+
+    tag = _METRIC_NAMES[network].replace("_e2e", "")
+    records = [
+        {"metric": f"serve_poison_healthy_lost_{tag}",
+         "value": healthy_lost, "unit": "requests", "vs_baseline": None},
+        {"metric": f"serve_poison_healthy_byte_identical_{tag}",
+         "value": int(byte_identical), "unit": "bool", "vs_baseline": None},
+        {"metric": f"serve_poison_quarantined_within_k_{tag}",
+         "value": int(within_k), "unit": "bool", "vs_baseline": None},
+        {"metric": f"serve_poison_replicas_healthy_{tag}",
+         "value": int(all_healthy), "unit": "bool", "vs_baseline": None},
+        {"metric": f"serve_poison_trips_{tag}",
+         "value": q_snap["trips"], "unit": "trips", "vs_baseline": None},
+        {"metric": f"serve_poison_fastfail_hits_{tag}",
+         "value": q_snap["fastfail_hits"], "unit": "requests",
+         "vs_baseline": None},
+    ]
+    report = {
+        "replicas": replicas,
+        "requests": requests,
+        "concurrency": concurrency,
+        "k": k,
+        "poison_mix_rate": mix.count("qod") / len(mix),
+        "poison_requests": requests - len(healthy_idx),
+        "digests": [d[:12] for d in digests],
+        "fault_spec": spec,
+        "claims": claims,
+        "baseline": {"outcomes": base_report["outcomes"]},
+        "poisoned": {
+            "outcomes": poi_report["outcomes"],
+            "poison_outcomes": poi_report.get("poison_outcomes"),
+            "engine_requests": poi_report["engine"]["requests"],
+            "quarantine": q_snap,
+        },
+    }
+    return records, report
+
+
 def bench_swap(
     network: str,
     requests: int,
@@ -1589,6 +1766,16 @@ def main():
              "byte-identical + recovery-time evidence)",
     )
     ap.add_argument(
+        "--poison", action="store_true",
+        help="query-of-death containment bench: ~5%% deterministic "
+             "poison inside healthy traffic on a 2-replica pool with "
+             "quarantine on (zero healthy losses, byte-identical "
+             "healthy detections, <=K trips per poison digest, all "
+             "replicas healthy at the end)",
+    )
+    ap.add_argument("--poison_k", type=int, default=2,
+                    help="quarantine trip threshold K for --poison")
+    ap.add_argument(
         "--slo", action="store_true",
         help="SLO-tier serving bench: sparse interactive probes vs a "
              "saturating bulk backlog, single-lane baseline vs two-lane "
@@ -1726,6 +1913,21 @@ def main():
             network, args.serve_requests, args.serve_concurrency,
             args.serve_max_batch, args.serve_linger_ms,
             small=not args.serve_full, replicas=args.serve_replicas,
+        )
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
+
+    if args.poison:
+        network = "resnet50" if args.network == "resnet" else args.network
+        records, report = bench_poison(
+            network, args.serve_requests, args.serve_concurrency,
+            args.serve_max_batch, args.serve_linger_ms,
+            replicas=max(2, args.serve_replicas), k=args.poison_k,
+            small=not args.serve_full,
         )
         for rec in records:
             print(json.dumps(rec), flush=True)

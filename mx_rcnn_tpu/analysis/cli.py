@@ -102,6 +102,50 @@ def _check_slo_schema(name: str, doc: dict) -> List[str]:
     return errors
 
 
+# the poison artifact must keep proving the four ISSUE 12 containment
+# claims — a bench refactor that drops one (or lets it go false) is a
+# lint failure, not a quietly weaker artifact
+_POISON_CLAIMS = (
+    "zero_healthy_lost", "healthy_byte_identical",
+    "poison_quarantined_within_k", "all_replicas_healthy",
+)
+_POISON_METRIC_PREFIXES = (
+    "serve_poison_healthy_lost",
+    "serve_poison_healthy_byte_identical",
+    "serve_poison_quarantined_within_k",
+    "serve_poison_replicas_healthy",
+)
+
+
+def _check_poison_schema(name: str, doc: dict) -> List[str]:
+    errors = []
+    report = doc.get("report") if isinstance(doc, dict) else None
+    if not isinstance(report, dict):
+        return [f"bench artifact {name}: missing report object"]
+    claims = report.get("claims")
+    if not isinstance(claims, dict):
+        return [f"bench artifact {name}: report.claims missing"]
+    for c in _POISON_CLAIMS:
+        if c not in claims:
+            errors.append(f"bench artifact {name}: claim '{c}' missing")
+        elif claims[c] is not True:
+            errors.append(f"bench artifact {name}: claim '{c}' not true")
+    if not report.get("digests"):
+        errors.append(f"bench artifact {name}: report.digests empty — the "
+                      f"run drew no poison, so the claims are vacuous")
+    metrics = {
+        r.get("metric", "")
+        for r in doc.get("records", [])
+        if isinstance(r, dict)
+    }
+    for prefix in _POISON_METRIC_PREFIXES:
+        if not any(m.startswith(prefix) for m in metrics):
+            errors.append(
+                f"bench artifact {name}: no record metric '{prefix}*'"
+            )
+    return errors
+
+
 def check_bench_artifacts(root: Path) -> List[str]:
     errors = []
     for f in sorted(root.glob("BENCH_*.json")):
@@ -117,6 +161,8 @@ def check_bench_artifacts(root: Path) -> List[str]:
             errors += _check_elastic_schema(f.name, doc)
         if f.name == "BENCH_serve_slo_cpu.json":
             errors += _check_slo_schema(f.name, doc)
+        if f.name == "BENCH_poison_cpu.json":
+            errors += _check_poison_schema(f.name, doc)
     return errors
 
 

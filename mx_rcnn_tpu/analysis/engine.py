@@ -261,6 +261,7 @@ def default_rules() -> List[Rule]:
     from mx_rcnn_tpu.analysis.rules_futures import ExactlyOnce
     from mx_rcnn_tpu.analysis.rules_faults import FaultCoverage
     from mx_rcnn_tpu.analysis.rules_signals import SignalSafety
+    from mx_rcnn_tpu.analysis.rules_requeue import BoundedRequeue
 
     return [
         HostCopyEscape(),
@@ -270,6 +271,7 @@ def default_rules() -> List[Rule]:
         ExactlyOnce(),
         FaultCoverage(),
         SignalSafety(),
+        BoundedRequeue(),
     ]
 
 

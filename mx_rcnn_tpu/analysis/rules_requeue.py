@@ -1,0 +1,185 @@
+"""R8 bounded requeue — the ISSUE 12 containment-loop rule.
+
+The replica pool's failure handling re-dispatches work: a drain
+requeues, a slow primary hedges, the engine splits an implicated batch
+and resubmits members solo.  Every one of those re-dispatch edges must
+be charged against a per-request :class:`RetryBudget` — an uncharged
+requeue loop is exactly how a query of death circulates forever,
+serially tripping replicas (the incident class PR 12 contains).
+
+Detection: a *requeue site* is a ``<recv>.submit(...)`` call whose
+receiver's last segment names a dispatch target
+(``replica``/``primary``/``backup``/``sibling``/``batcher`` — NOT
+``engine`` or the completion ``pool``, whose submits are intake, not
+re-dispatch).  The site is *triggered* when it can run more than once
+for the same work item:
+
+* lexically inside a ``for``/``while`` loop, or
+* inside an ``except`` handler (failure-path re-dispatch), or
+* in a function whose name says retry
+  (``hedge``/``requeue``/``resubmit``/``failover``/``retry``).
+
+A triggered site is clean only if its enclosing function reaches a
+``<...budget>.spend(...)`` call — directly, or through calls resolved
+to a fixed point across the serve modules (the R4 idiom: receivers by
+unique method name).  Anything else is an unbounded requeue.
+
+Like R5, this is an under-approximation by design: spending under a
+condition still counts (the runtime raises ``RetriesExhausted`` at
+zero), and the fault matrix owns the stronger guarantee.  It is
+zero-noise on code that charges its re-dispatches.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from mx_rcnn_tpu.analysis.engine import Finding, Module, Rule, dotted
+
+# receivers whose .submit() is a re-dispatch of existing work
+REDISPATCH_RECV = re.compile(
+    r"(replica|primary|backup|sibling|batcher)$", re.IGNORECASE
+)
+# function names that declare a retry path
+RETRYISH_NAME = re.compile(
+    r"(hedge|requeue|resubmit|failover|retry)", re.IGNORECASE
+)
+BUDGETISH = re.compile(r"budget", re.IGNORECASE)
+
+_FuncKey = Tuple[str, str]  # (module path, qualname)
+
+
+def _last_segment(recv: Optional[str]) -> str:
+    return (recv or "").rsplit(".", 1)[-1]
+
+
+def _spends_budget(call: ast.Call) -> bool:
+    return (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == "spend"
+        and bool(BUDGETISH.search(_last_segment(dotted(call.func.value))))
+    )
+
+
+class BoundedRequeue(Rule):
+    id = "R8"
+    name = "bounded requeue"
+
+    def _in_scope(self, module: Module) -> bool:
+        return "/serve/" in f"/{module.path}"
+
+    def finalize(self, modules: Sequence[Module]) -> List[Finding]:
+        scoped = [m for m in modules if self._in_scope(m)]
+        if not scoped:
+            return []
+
+        # ---- pass 1: per-function spend seeds and call edges ---------
+        funcs: Dict[_FuncKey, ast.FunctionDef] = {}
+        by_name: Dict[str, List[_FuncKey]] = {}
+        spends: Set[_FuncKey] = set()
+        calls: Dict[_FuncKey, Set[str]] = {}
+        for m in scoped:
+            for node in ast.walk(m.tree):
+                if not isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                key = (m.path, m.qualnames.get(node, node.name))
+                funcs[key] = node
+                by_name.setdefault(node.name, []).append(key)
+                callees: Set[str] = set()
+                for n in self._own_nodes(m, node):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    if _spends_budget(n):
+                        spends.add(key)
+                    elif isinstance(n.func, ast.Attribute):
+                        callees.add(n.func.attr)
+                    elif isinstance(n.func, ast.Name):
+                        callees.add(n.func.id)
+                calls[key] = callees
+
+        # ---- pass 2: propagate spend-reachability to a fixed point ---
+        changed = True
+        iters = 0
+        while changed and iters < 50:
+            changed = False
+            iters += 1
+            for key, callees in calls.items():
+                if key in spends:
+                    continue
+                for name in callees:
+                    owners = by_name.get(name, ())
+                    # unique-name resolution, the R4 fallback: an
+                    # ambiguous callee never transfers coverage
+                    if len(owners) == 1 and owners[0] in spends:
+                        spends.add(key)
+                        changed = True
+                        break
+
+        # ---- pass 3: triggered requeue sites must reach a spend ------
+        out: List[Finding] = []
+        for m in scoped:
+            for n in ast.walk(m.tree):
+                if not (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "submit"
+                ):
+                    continue
+                recv = dotted(n.func.value)
+                if not REDISPATCH_RECV.search(_last_segment(recv)):
+                    continue
+                fn = m.enclosing_def(n)
+                if fn is None:
+                    continue
+                trigger = self._trigger(m, n, fn)
+                if trigger is None:
+                    continue
+                key = (m.path, m.qualnames.get(fn, fn.name))
+                if key in spends:
+                    continue
+                out.append(
+                    Finding(
+                        self.id,
+                        m.path,
+                        n.lineno,
+                        m.scope_of(n),
+                        f"`{recv}.submit` re-dispatches on a retry path "
+                        f"({trigger}) with no reachable "
+                        f"`RetryBudget.spend` — an unbounded requeue "
+                        f"loops a query of death forever",
+                    )
+                )
+        return out
+
+    # ---- helpers ----------------------------------------------------
+
+    def _own_nodes(self, m: Module, fn: ast.AST):
+        """Walk ``fn`` excluding nested def bodies (their spends don't
+        execute on this function's path), but including lambdas."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _trigger(
+        self, m: Module, call: ast.Call, fn: ast.AST
+    ) -> Optional[str]:
+        cur = m.parent(call)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.For, ast.While)):
+                return "inside a loop"
+            if isinstance(cur, ast.ExceptHandler):
+                return "inside an except handler"
+            cur = m.parent(cur)
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            RETRYISH_NAME.search(fn.name)
+        ):
+            return f"function `{fn.name}` is a retry path"
+        return None

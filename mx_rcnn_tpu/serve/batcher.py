@@ -54,6 +54,7 @@ import time
 from collections import deque
 
 from mx_rcnn_tpu.analysis.lockcheck import make_condition
+from mx_rcnn_tpu.serve.quarantine import validate_request
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -95,6 +96,9 @@ class Request:
     model: Optional[str] = None          # registry model id (None = default)
     lane: str = DEFAULT_LANE             # SLO class: "interactive" | "bulk"
     cache_key: Optional[Tuple] = None    # response-cache key (engine-set)
+    digest: Optional[str] = None         # raw-input identity (containment)
+    budget: Optional[object] = None      # quarantine.RetryBudget (engine-set)
+    solo: bool = False                   # engine resubmit: release as batch-of-1
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -142,13 +146,20 @@ class DynamicBatcher:
 
     # ------------------------------------------------------------- producers
     def submit(self, req: Request) -> None:
+        # structural gate in the *submitting* thread: a zero-dim or
+        # dtype-object image must fail the caller, not crash the shared
+        # assembler thread downstream (ISSUE 12)
+        validate_request(req)
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is closed")
             # free dead capacity before judging fullness: under overload
             # with deadlines, expired requests must not hold live ones out
             self._sweep_expired(time.monotonic())
-            if self._count >= self.max_queue:
+            # a solo resubmit is an already-admitted in-flight request
+            # bouncing through containment; rejecting it here would turn
+            # quarantine into request loss, so it re-enters above the cap
+            if self._count >= self.max_queue and not req.solo:
                 raise QueueFull(
                     f"serving queue at capacity ({self.max_queue}) — "
                     f"client should back off"
@@ -271,8 +282,11 @@ class DynamicBatcher:
                 key, release_at, flag = choice
                 q = self._queues[key]
                 full = len(q) >= self.max_batch
-                if full or self._closed or now >= release_at:
-                    n = min(len(q), self.max_batch)
+                # a solo head (containment resubmit) releases immediately
+                # as a batch-of-1: isolating it is the whole point
+                head_solo = bool(q) and q[0].solo
+                if full or head_solo or self._closed or now >= release_at:
+                    n = 1 if head_solo else min(len(q), self.max_batch)
                     batch = [q.popleft() for _ in range(n)]
                     self._count -= n
                     for r in batch:

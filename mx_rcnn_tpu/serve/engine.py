@@ -58,13 +58,25 @@ from mx_rcnn_tpu.serve.batcher import (
     Request,
 )
 from mx_rcnn_tpu.serve.metrics import ServeMetrics
+from mx_rcnn_tpu.serve.quarantine import (
+    BatchBudget,
+    InvalidRequest,
+    PoisonRequest,
+    RetriesExhausted,
+    RetryBudget,
+    request_digest,
+    validate_image,
+)
 from mx_rcnn_tpu.serve.runner import ServeRunner
 
 # DeadlineExceeded historically lived here; it moved to serve.batcher so
 # the expired-request sweep can raise it without a circular import, and
 # stays re-exported for every existing `from serve.engine import` site.
+# The containment taxonomy (ISSUE 12) lives in serve.quarantine and is
+# re-exported here for the same reason: clients catch engine errors.
 __all__ = [
     "DeadlineExceeded", "EngineStopped", "ServingEngine",
+    "InvalidRequest", "PoisonRequest", "RetriesExhausted",
 ]
 
 
@@ -87,6 +99,7 @@ class ServingEngine:
         interactive_linger: float = 0.0,
         bulk_age_limit: float = 2.0,
         response_cache=None,
+        retry_budget: int = 8,
     ):
         self.runner = runner
         self.batcher = DynamicBatcher(
@@ -112,6 +125,12 @@ class ServingEngine:
         # a ReplicaPool routes/retries/hedges internally; the engine then
         # skips its own RetryPolicy and sheds early on pool health
         self._routed = hasattr(runner, "replicas")
+        # query-of-death containment (ISSUE 12): active when the pool
+        # carries a QuarantineTable — the engine then digests every
+        # request at admission, attaches retry budgets, and splits
+        # implicated batches instead of failing them wholesale
+        self._quarantine = getattr(runner, "quarantine", None)
+        self._retry_budget = max(1, int(retry_budget))
         self._aborting = False
         # every not-yet-resolved request, so stop() can sweep leftovers
         # with a terminal EngineStopped instead of stranding submitters
@@ -217,19 +236,50 @@ class ServingEngine:
         (None = the default model — the tenancy request schema);
         ``lane`` tags the SLO class (``"interactive"`` | ``"bulk"``,
         None = the model's registry default).  Raises
+        :class:`~mx_rcnn_tpu.serve.quarantine.InvalidRequest` (failed
+        the admission gate),
+        :class:`~mx_rcnn_tpu.serve.quarantine.PoisonRequest` (digest is
+        quarantined),
         :class:`~mx_rcnn_tpu.serve.buckets.BucketOverflow` (oversize),
         :class:`~mx_rcnn_tpu.serve.batcher.QueueFull` (backpressure), or
         :class:`~mx_rcnn_tpu.serve.registry.UnknownModel` synchronously
         — all count as ``rejected``."""
         if not self._started:
             raise RuntimeError("engine not started")
+        reg = getattr(self.runner, "registry", None)
         if model is not None:
-            reg = getattr(self.runner, "registry", None)
             if reg is not None and not reg.has(model):
                 self.metrics.inc("rejected")
                 from mx_rcnn_tpu.serve.registry import UnknownModel
 
                 raise UnknownModel(model)
+        # admission gate (ISSUE 12): malformed work fails the CALLER
+        # with a typed error before it can reach the batcher or crash
+        # the shared assembler thread; registry-declared per-model
+        # bounds tighten the default shape/size limits
+        limits = None
+        if reg is not None and hasattr(reg, "limits"):
+            try:
+                limits = reg.limits(model)
+            except Exception:  # noqa: BLE001 — no entry yet: defaults
+                limits = None
+        try:
+            im = validate_image(im, limits)
+        except InvalidRequest:
+            self.metrics.inc("invalid")
+            self.metrics.inc("rejected")
+            raise
+        digest = None
+        if self._quarantine is not None:
+            digest = request_digest(im)
+            if self._quarantine.quarantined(digest):
+                # fail fast: a quarantined query of death must not cost
+                # another replica trip, or even a queue slot
+                self.metrics.inc("poisoned")
+                self.metrics.inc("rejected")
+                raise PoisonRequest(
+                    f"digest {digest[:12]} is quarantined (query of death)"
+                )
         lane = self._lane_for(model, lane)
         cache_key = None
         if self.response_cache is not None:
@@ -286,6 +336,9 @@ class ServingEngine:
                 )
             req.lane = lane
             req.cache_key = cache_key
+            if digest is not None:
+                req.digest = digest
+                req.budget = RetryBudget(self._retry_budget)
             self.batcher.submit(req)
         except Exception:
             self.metrics.inc("rejected")
@@ -392,19 +445,21 @@ class ServingEngine:
                 # batch; the tightest live deadline drives the hedge,
                 # and the lane tag tightens it further for interactive
                 deadlines = [r.deadline for r in reqs if r.deadline is not None]
+                rkw = dict(mkw)
+                if self._quarantine is not None:
+                    # containment: the pool sees member identities and a
+                    # shared budget view (one re-dispatch re-runs every
+                    # member, so one spend decrements each)
+                    rkw["digests"] = tuple(r.digest for r in reqs)
+                    rkw["budget"] = BatchBudget([r.budget for r in reqs])
                 out = self.runner.run(
                     batch, deadline=min(deadlines) if deadlines else None,
-                    lane=lane, **mkw,
+                    lane=lane, **rkw,
                 )
             else:
                 out = self.retry.run(attempt_run)
         except Exception as e:
-            self.metrics.inc("failed", len(reqs))
-            for r in reqs:
-                if model is not None:
-                    self.metrics.record_model(model, ok=False)
-                self.metrics.record_lane(r.lane, ok=False)
-                self._resolve(r, exc=e)
+            self._settle_failed(reqs, e)
             return
         done = time.monotonic()
         self.metrics.service.record(done - t0)
@@ -441,6 +496,11 @@ class ServingEngine:
                 # not seed the cache with superseded-version results
                 if self._live_version(model) == r.cache_key[1]:
                     self.response_cache.put(r.cache_key, dets)
+            if self._quarantine is not None and r.digest is not None:
+                # a suspect that completes cleanly was an innocent
+                # co-batched bystander: drop the suspicion
+                if self._quarantine.exonerate(r.digest):
+                    self.metrics.inc("exonerated")
             self.metrics.inc("completed")
             e2e_s = time.monotonic() - r.enqueue_t
             self.metrics.e2e.record(e2e_s)
@@ -450,6 +510,68 @@ class ServingEngine:
                 r.lane, e2e_s, queue_wait_s=r.picked_t - r.enqueue_t
             )
             self._resolve(r, dets)
+
+    # -------------------------------------------------- containment triage
+    def _fail_one(self, req: Request,
+                  exc: BaseException) -> None:
+        self.metrics.inc("failed")
+        if req.model is not None:
+            self.metrics.record_model(req.model, ok=False)
+        self.metrics.record_lane(req.lane, ok=False)
+        self._resolve(req, exc=exc)
+
+    def _settle_failed(self, reqs: List[Request],
+                       exc: BaseException) -> None:
+        """Batch-level failure triage.  Without containment this is the
+        legacy wholesale fail.  With it, each member settles on its own:
+        a quarantined digest fails fast as :class:`PoisonRequest`, a
+        member with budget left is split out and resubmitted solo (so
+        the next trip attributes unambiguously and innocents stop
+        co-tripping with the poison), and a spent budget resolves
+        :class:`RetriesExhausted`."""
+        qt = self._quarantine
+        for r in reqs:
+            if qt is not None and r.digest is not None \
+                    and qt.quarantined(r.digest):
+                self.metrics.inc("poisoned")
+                self._fail_one(r, PoisonRequest(
+                    f"digest {r.digest[:12]} quarantined after replica "
+                    f"trips"
+                ))
+                continue
+            budget = r.budget
+            if qt is not None and budget is not None \
+                    and budget.remaining > 0 and self._started \
+                    and not self._aborting:
+                self._resubmit(r)
+                continue
+            if budget is not None and budget.remaining <= 0:
+                e: BaseException = RetriesExhausted(
+                    f"retry budget {budget.total} spent; last error: "
+                    f"{exc!r}"
+                )
+                e.__cause__ = exc
+                self.metrics.inc("exhausted")
+                self._fail_one(r, e)
+                continue
+            self._fail_one(r, exc)
+
+    def _resubmit(self, req: Request) -> None:
+        """Solo retry of one member of a failed or implicated batch.
+        The spend here is what bounds the containment loop (graftlint
+        R8); ``solo`` makes the batcher release it as a batch-of-1."""
+        try:
+            req.budget.spend("resubmit")
+        except RetriesExhausted as e:
+            self.metrics.inc("exhausted")
+            self._fail_one(req, e)
+            return
+        req.solo = True
+        self.metrics.inc("resubmitted")
+        try:
+            self.batcher.submit(req)
+        except Exception as e:  # noqa: BLE001 — closed batcher at stop
+            self._fail_one(req, e)
 
     # ----------------------------------------------------------- lifecycle
     def swap(
@@ -503,6 +625,8 @@ class ServingEngine:
             out["completion"] = self._pool.stats()
         if self._routed:
             out["pool"] = self.runner.snapshot()
+        if self._quarantine is not None:
+            out["quarantine"] = self._quarantine.snapshot()
         reg = getattr(self.runner, "registry", None)
         if reg is not None:
             out["registry"] = reg.snapshot()

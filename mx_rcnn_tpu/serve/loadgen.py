@@ -37,6 +37,39 @@ def synthetic_image(index: int, h: int, w: int, seed: int = 0) -> np.ndarray:
     return rng.randint(0, 256, (h, w, 3)).astype(np.float32)
 
 
+#: poison_mix flavors (ISSUE 12).  The malformed three must be rejected
+#: at the admission gate; "qod" is a WELL-FORMED query of death — valid
+#: pixels whose digest the bench wires to a ``poison_*`` fault injector.
+POISON_FLAVORS = ("qod", "nan", "empty", "objdtype")
+
+
+def qod_image(h: int, w: int, seed: int = 0) -> np.ndarray:
+    """The deterministic query-of-death image for size ``(h, w)``.
+    Depends on (h, w, seed) only — NOT the request index — so every qod
+    request of one size shares a single digest, which is what lets the
+    bench compute ``request_digest(qod_image(...))`` up front and key
+    its fault spec on it."""
+    rng = np.random.RandomState((seed * 7_777_777 + h * 10_007 + w)
+                                % (2**31 - 1))
+    return rng.randint(0, 256, (h, w, 3)).astype(np.float32)
+
+
+def poison_image(flavor: str, index: int, h: int, w: int,
+                 seed: int = 0) -> np.ndarray:
+    """Materialize one poison_mix flavor for request ``index``."""
+    if flavor == "qod":
+        return qod_image(h, w, seed)
+    if flavor == "nan":
+        im = synthetic_image(index, h, w, seed)
+        im[0, 0, 0] = np.nan
+        return im
+    if flavor == "empty":
+        return np.zeros((0, 0, 3), np.float32)
+    if flavor == "objdtype":
+        return np.empty((2, 2, 3), dtype=object)
+    raise ValueError(f"unknown poison flavor {flavor!r}")
+
+
 def run_load(
     engine,
     num_requests: int = 64,
@@ -48,6 +81,7 @@ def run_load(
     collect: bool = False,
     models: Optional[Sequence[str]] = None,
     lanes: Optional[Sequence[Optional[str]]] = None,
+    poison_mix: Optional[Sequence[Optional[str]]] = None,
 ) -> Dict:
     """Drive ``engine`` with ``num_requests`` synthetic images; returns a
     report dict (wall/throughput/outcome counts + the engine's metrics
@@ -67,6 +101,15 @@ def run_load(
     adding lanes to an existing scenario leaves its size/model streams
     unchanged.  Per-lane outcome counts land under
     ``report["lane_outcomes"]``.
+
+    ``poison_mix`` (optional) draws each request's poison flavor from
+    the sequence the same way (``None`` entries mean healthy traffic —
+    e.g. ``[None]*19 + ["qod"]`` is a ~5% poison mix).  Flavors are the
+    :data:`POISON_FLAVORS`: the malformed three must be rejected at the
+    engine's admission gate, while ``"qod"`` submits the deterministic
+    :func:`qod_image` whose digest a fault spec can target.  Drawn AFTER
+    lanes so existing scenarios keep their streams.  Per-flavor outcome
+    counts land under ``report["poison_outcomes"]``.
 
     ``collect=True`` additionally stores each request's resolution under
     ``report["_results"]`` — ``{index: ("ok", detections) | (kind, repr)}``
@@ -90,14 +133,32 @@ def run_load(
         [lanes[size_rng.randint(len(lanes))] for _ in range(num_requests)]
         if lanes else None
     )
+    req_poison = (
+        [poison_mix[size_rng.randint(len(poison_mix))]
+         for _ in range(num_requests)]
+        if poison_mix else None
+    )
     counter = iter(range(num_requests))
     lock = threading.Lock()
-    outcomes = {"ok": 0, "deadline": 0, "error": 0, "queue_full_retries": 0}
+    outcomes = {"ok": 0, "deadline": 0, "error": 0, "queue_full_retries": 0,
+                "invalid": 0, "poison": 0, "exhausted": 0}
     lane_outcomes: Dict[str, Dict[str, int]] = {}
+    poison_outcomes: Dict[str, Dict[str, int]] = {}
     results: Dict[int, Tuple[str, object]] = {}
     times: Dict[int, Tuple[float, float]] = {}
 
-    def note(key: str, lane: Optional[str] = None) -> None:
+    def classify(e: BaseException) -> str:
+        name = type(e).__name__
+        if "InvalidRequest" in name:
+            return "invalid"
+        if "Poison" in name:
+            return "poison"
+        if "Exhausted" in name:
+            return "exhausted"
+        return "deadline" if "Deadline" in name else "error"
+
+    def note(key: str, lane: Optional[str] = None,
+             flavor: Optional[str] = None) -> None:
         with lock:
             outcomes[key] += 1
             if lane is not None:
@@ -106,6 +167,9 @@ def run_load(
                 )
                 if key in per:
                     per[key] += 1
+            if flavor is not None:
+                pf = poison_outcomes.setdefault(flavor, {})
+                pf[key] = pf.get(key, 0) + 1
 
     def client() -> None:
         while True:
@@ -114,7 +178,11 @@ def run_load(
             if i is None:
                 return
             h, w = req_sizes[i]
-            im = synthetic_image(i, h, w, seed)
+            flavor = req_poison[i] if req_poison is not None else None
+            if flavor is None:
+                im = synthetic_image(i, h, w, seed)
+            else:
+                im = poison_image(flavor, i, h, w, seed)
             mkw = (
                 {} if req_models is None or req_models[i] is None
                 else {"model": req_models[i]}
@@ -123,6 +191,7 @@ def run_load(
             if lane is not None:
                 mkw["lane"] = lane
             t_submit = time.monotonic()
+            fut = None
             while True:
                 try:
                     fut = engine.submit(im, deadline_s=deadline_s, **mkw)
@@ -130,18 +199,28 @@ def run_load(
                 except QueueFull:
                     note("queue_full_retries")
                     time.sleep(queue_full_backoff)
-            try:
-                dets = fut.result()
-                note("ok", lane)
-                if collect:
-                    with lock:
-                        results[i] = ("ok", dets)
-            except Exception as e:
-                kind = "deadline" if "Deadline" in type(e).__name__ else "error"
-                note(kind, lane)
-                if collect:
-                    with lock:
-                        results[i] = (kind, repr(e))
+                except Exception as e:
+                    # synchronous reject: admission gate (InvalidRequest)
+                    # or quarantine fast-fail (PoisonRequest)
+                    kind = classify(e)
+                    note(kind, lane, flavor)
+                    if collect:
+                        with lock:
+                            results[i] = (kind, repr(e))
+                    break
+            if fut is not None:
+                try:
+                    dets = fut.result()
+                    note("ok", lane, flavor)
+                    if collect:
+                        with lock:
+                            results[i] = ("ok", dets)
+                except Exception as e:
+                    kind = classify(e)
+                    note(kind, lane, flavor)
+                    if collect:
+                        with lock:
+                            results[i] = (kind, repr(e))
             if collect:
                 with lock:
                     times[i] = (t_submit, time.monotonic())
@@ -173,6 +252,12 @@ def run_load(
     if lanes:
         report["lanes"] = list(lanes)
         report["lane_outcomes"] = lane_outcomes
+    if poison_mix:
+        report["poison_mix"] = list(poison_mix)
+        report["poison_flavors"] = (
+            [req_poison[i] for i in range(num_requests)]
+        )
+        report["poison_outcomes"] = poison_outcomes
     if collect:
         report["_results"] = results
         report["_times"] = times

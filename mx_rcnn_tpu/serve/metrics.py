@@ -122,6 +122,12 @@ class ServeMetrics:
         self.retried = 0       # batch re-executions via RetryPolicy
         self.shed = 0          # rejected early on low healthy fraction
         self.stopped = 0       # resolved EngineStopped at teardown
+        # query-of-death containment stages (ISSUE 12)
+        self.invalid = 0       # rejected at the admission gate
+        self.poisoned = 0      # failed fast on a quarantined digest
+        self.exhausted = 0     # retry budget spent: RetriesExhausted
+        self.resubmitted = 0   # split from an implicated batch, solo retry
+        self.exonerated = 0    # suspects cleared by later success
         # batch occupancy: real requests per padded device-batch slot
         self.batches = 0
         self.batch_real = 0
@@ -225,6 +231,11 @@ class ServeMetrics:
                     "retried": self.retried,
                     "shed": self.shed,
                     "stopped": self.stopped,
+                    "invalid": self.invalid,
+                    "poisoned": self.poisoned,
+                    "exhausted": self.exhausted,
+                    "resubmitted": self.resubmitted,
+                    "exonerated": self.exonerated,
                 },
                 "batches": {
                     "count": self.batches,

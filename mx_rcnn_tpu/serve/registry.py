@@ -159,11 +159,13 @@ class _Entry:
     inherit it — an interactive-tier model taints its traffic)."""
 
     def __init__(self, model_id: str, model: Any, cfg: Any,
-                 slo_class: str = "bulk"):
+                 slo_class: str = "bulk",
+                 limits: Optional[Dict[str, Any]] = None):
         self.model_id = model_id
         self.model = model
         self.cfg = cfg
         self.slo_class = slo_class
+        self.limits = dict(limits or {})
         self.versions: List[ModelVersion] = []
         self.live: Optional[ModelVersion] = None
         self.next_version = 1
@@ -231,6 +233,7 @@ class ModelRegistry:
         digest: Optional[str] = None,
         source: str = "init",
         slo_class: str = "bulk",
+        limits: Optional[Dict[str, Any]] = None,
     ) -> ModelVersion:
         """Add a model family with its v1 params (already loaded and
         trusted by the caller — the CLI verifies checkpoint sources
@@ -247,7 +250,8 @@ class ModelRegistry:
         with self._lock:
             if model_id in self._entries:
                 raise RegistryError(f"model {model_id!r} already registered")
-            e = _Entry(model_id, model, cfg, slo_class=slo_class)
+            e = _Entry(model_id, model, cfg, slo_class=slo_class,
+                       limits=limits)
             v = ModelVersion(
                 model_id, e.next_version, params=params, digest=digest,
                 source=source, state=VersionState.LOADING,
@@ -299,6 +303,13 @@ class ModelRegistry:
         submit)."""
         with self._lock:
             return self.entry(model_id).slo_class
+
+    def limits(self, model_id: Optional[str] = None) -> Dict[str, Any]:
+        """Per-model admission bounds (``max_side`` / ``max_pixels``)
+        for the engine's validation gate; empty dict means the
+        ``serve.quarantine`` defaults apply."""
+        with self._lock:
+            return dict(self.entry(model_id).limits)
 
     # --------------------------------------------- live-change listeners
     def subscribe_live(self, callback: Any) -> None:

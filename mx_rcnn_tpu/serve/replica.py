@@ -71,7 +71,7 @@ import threading
 import time
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -133,6 +133,8 @@ class _Dispatch:
     ordinal: int = -1    # per-replica batch ordinal, set at predict time
     model: Optional[str] = None  # registry model id (None = default)
     lane: Optional[str] = None   # SLO class tag (observability only)
+    digests: Tuple[str, ...] = ()  # member request digests (containment)
+    implicated: bool = False     # this dispatch's digests were trip suspects
 
     def resolve(self, result=None, exc: Optional[BaseException] = None) -> bool:
         """Set the future if still unset; False when it already resolved
@@ -156,9 +158,11 @@ class Replica:
         runner_factory: Callable[[int], Any],
         policy: Optional[HealthPolicy] = None,
         name: str = "replica",
+        quarantine: Optional[Any] = None,
     ):
         self.index = int(index)
         self.policy = policy or HealthPolicy()
+        self.quarantine = quarantine  # pool-shared QuarantineTable (or None)
         self._factory = runner_factory
         self.runner = runner_factory(self.index)
         self._lock = make_lock("Replica._lock")
@@ -188,6 +192,9 @@ class Replica:
         self.last_rewarm_rungs = 0   # rungs the last partial rewarm compiled
         self.breaker_opens = 0
         self.last_backoff = 0.0
+        self.isolation_probes = 0     # suspect replays run before rejoin
+        self.isolation_confirmed = 0  # replays that confirmed poison
+        self.isolation_cleared = 0    # replays that cleared the suspect
         self._t0 = time.monotonic()
         self._worker = threading.Thread(
             target=self._loop, name=f"{name}-{index}", daemon=True
@@ -235,12 +242,14 @@ class Replica:
         deadline: Optional[float] = None,
         model: Optional[str] = None,
         lane: Optional[str] = None,
+        digests: Optional[Tuple[str, ...]] = None,
     ) -> _Dispatch:
         """Enqueue one batch; returns the dispatch whose future resolves
         exactly once.  A non-routable replica fails it immediately with
         :class:`ReplicaDrained` instead of accepting work it would only
         drain later."""
-        d = _Dispatch(batch=batch, deadline=deadline, model=model, lane=lane)
+        d = _Dispatch(batch=batch, deadline=deadline, model=model, lane=lane,
+                      digests=tuple(digests or ()))
         with self._lock:
             if self._stop or self.state not in (
                 ReplicaState.HEALTHY, ReplicaState.DEGRADED
@@ -252,17 +261,25 @@ class Replica:
             self._inbox.put(d)
         return d
 
-    def trip(self, reason: str) -> None:
+    def trip(self, reason: str,
+             suspect: Optional[_Dispatch] = None) -> None:
         """Force DRAINING now (watchdog expiry, failure budget, or an
         operator drain): fail the in-flight dispatch over, requeue-fail
         everything queued, and let the worker run recovery.  Idempotent;
-        callable from any thread."""
+        callable from any thread.  ``suspect`` names the dispatch that
+        caused a failure-budget trip (the in-flight one is implicated by
+        default); its member digests are recorded in the pool's
+        quarantine table as attribution suspects.  Queued dispatches
+        were never running, so they drain *without* implication."""
         with self._lock:
             if self.state in (ReplicaState.DRAINING, ReplicaState.RECOVERING):
                 return
             self._log_transition(ReplicaState.DRAINING, reason)
             self._trip_times.append(time.monotonic())
-            cur = self._current
+            cur = suspect if suspect is not None else self._current
+        if cur is not None:
+            # mark before resolving so the router's waiter can observe it
+            cur.implicated = True
         drained = ReplicaDrained(f"replica {self.index} draining ({reason})")
         if cur is not None and cur.resolve(exc=drained):
             self.requeued_out += 1
@@ -273,10 +290,40 @@ class Replica:
                 break
             if d is not None and d.resolve(exc=drained):
                 self.requeued_out += 1
+        if cur is not None and cur.digests and self.quarantine is not None:
+            self.quarantine.note_trip(
+                self._suspect_list(cur), replica=self.index, reason=reason
+            )
 
     def drain(self) -> None:
         """Operator-initiated drain (same path as a health trip)."""
         self.trip("drain")
+
+    def _suspect_list(self, d: _Dispatch) -> List[Any]:
+        """(digest, payload) per batch member for quarantine attribution.
+        Slot k of every batch array IS request k's prepared data
+        (``assemble`` keeps submit order and pads the tail), so the
+        payload captured here is enough to rebuild a sacrificial
+        batch-of-1 for the isolation probe."""
+        arrays = {
+            k: v for k, v in d.batch.items()
+            if isinstance(v, np.ndarray) and v.ndim >= 1
+        }
+        slots = next(iter(arrays.values())).shape[0] if arrays else 0
+        out = []
+        for i, dg in enumerate(d.digests):
+            payload = None
+            if arrays and i < slots:
+                payload = {
+                    "arrays": {
+                        k: np.array(v[i]) for k, v in arrays.items()
+                        if v.shape[0] == slots
+                    },
+                    "slots": slots,
+                    "model": d.model,
+                }
+            out.append((dg, payload))
+        return out
 
     # ------------------------------------------------------------ worker
     def _loop(self) -> None:
@@ -311,10 +358,12 @@ class Replica:
             self._watchdog = None
 
     def _predict(self, batch, ordinal: int, attempt: int,
-                 model: Optional[str] = None):
+                 model: Optional[str] = None,
+                 digests: Tuple[str, ...] = ()):
         if attempt:
             self.retried += 1
         faults.predict_fault(self.index, ordinal)
+        faults.poison_input(digests)
         # model kwarg only when the dispatch carries one, so runner
         # fakes with the legacy run(batch) signature keep working
         if model is None:
@@ -340,7 +389,8 @@ class Replica:
         try:
             out = self.policy.retry.run(
                 lambda attempt: self._predict(
-                    d.batch, d.ordinal, attempt, model=d.model
+                    d.batch, d.ordinal, attempt, model=d.model,
+                    digests=d.digests,
                 )
             )
         except Exception as e:  # noqa: BLE001 — typed failover, never a drop
@@ -350,7 +400,7 @@ class Replica:
             self.failures += 1
             if not d.resolve(exc=e):
                 self.abandoned += 1
-            self._note_failure(d.ordinal)
+            self._note_failure(d.ordinal, dispatch=d)
             return
         self._disarm_watchdog()
         dt = time.monotonic() - t0
@@ -389,10 +439,14 @@ class Replica:
         elif self.state is ReplicaState.DEGRADED and not slow:
             self._set_state(ReplicaState.HEALTHY, "good dispatch")
 
-    def _note_failure(self, ordinal: int) -> None:
+    def _note_failure(self, ordinal: int,
+                      dispatch: Optional[_Dispatch] = None) -> None:
         self._consecutive_failures += 1
         if self._consecutive_failures >= self.policy.fail_threshold:
-            self.trip(f"{self._consecutive_failures} consecutive failures")
+            # the dispatch whose failure crossed the budget is the trip's
+            # attribution suspect even though its future already resolved
+            self.trip(f"{self._consecutive_failures} consecutive failures",
+                      suspect=dispatch)
         else:
             self._set_state(ReplicaState.DEGRADED, "dispatch failed")
 
@@ -515,11 +569,67 @@ class Replica:
                     self._trip_times.append(time.monotonic())
                 initial = False
                 continue
+            if not initial:
+                # sacrificial suspect replay: confirm or clear the pool's
+                # top attribution suspect before taking real traffic, so
+                # K is reached in O(1) extra trips instead of K downed
+                # replicas.  Its verdict never blocks the rejoin.
+                self._isolation_probe()
             self._consecutive_failures = 0
             self._set_state(
                 ReplicaState.HEALTHY, "warmup ok" if initial else "rejoin"
             )
             return
+
+    @staticmethod
+    def _replay_batch(payload: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Rebuild a batch-of-1 from a captured suspect payload, padded
+        by slot-0 replication to the original slot count so the replay
+        hits an already-warmed compile signature."""
+        slots = max(1, int(payload.get("slots", 1)))
+        return {k: np.stack([v] * slots)
+                for k, v in payload["arrays"].items()}
+
+    def _isolation_probe(self) -> None:
+        """Replay the quarantine table's top suspect alone through the
+        fault-instrumented predict path.  A clean, fast replay clears
+        the suspect; a raise or a wedge (wall time past the stall
+        watchdog) confirms poison and quarantines the digest
+        immediately.  The probe is sacrificial: any outcome, the
+        recovery proceeds."""
+        qt = self.quarantine
+        if qt is None:
+            return
+        top = qt.top_suspect()
+        if top is None:
+            return
+        digest, payload = top
+        if payload is None:
+            qt.probe_result(digest, ok=None)  # nothing to replay: abstain
+            return
+        self.isolation_probes += 1
+        with self._lock:
+            ordinal = self._ordinal
+            self._ordinal += 1
+        t0 = time.monotonic()
+        ok = True
+        try:
+            batch = self._replay_batch(payload)
+            self._predict(batch, ordinal, 0, model=payload.get("model"),
+                          digests=(digest,))
+        except Exception as e:  # noqa: BLE001 — probe verdict, not a fault
+            logger.info(
+                "replica %d: isolation probe of %s raised: %r",
+                self.index, digest[:12], e,
+            )
+            ok = False
+        if ok and time.monotonic() - t0 > self.policy.stall_timeout:
+            ok = False  # the suspect wedges predict: poison confirmed
+        if ok:
+            self.isolation_cleared += 1
+        else:
+            self.isolation_confirmed += 1
+        qt.probe_result(digest, ok)
 
     # ---------------------------------------------------------- lifecycle
     def stop(self, timeout: float = 5.0) -> None:
@@ -551,6 +661,9 @@ class Replica:
             "last_rewarm_rungs": self.last_rewarm_rungs,
             "breaker_opens": self.breaker_opens,
             "last_backoff_s": round(self.last_backoff, 4),
+            "isolation_probes": self.isolation_probes,
+            "isolation_confirmed": self.isolation_confirmed,
+            "isolation_cleared": self.isolation_cleared,
             "ewma_ms": (
                 round(self._ewma_s * 1e3, 3) if self._ewma_s is not None
                 else None

@@ -51,6 +51,11 @@ import numpy as np
 from mx_rcnn_tpu.analysis.lockcheck import make_lock
 from mx_rcnn_tpu.serve.batcher import LANES
 from mx_rcnn_tpu.serve.metrics import LatencyHistogram
+from mx_rcnn_tpu.serve.quarantine import (
+    BatchImplicated,
+    PoisonBatch,
+    QuarantineTable,
+)
 from mx_rcnn_tpu.serve.replica import (
     HealthPolicy,
     Replica,
@@ -105,6 +110,7 @@ class ReplicaPool:
         min_hedge_timeout: float = 0.05,
         no_healthy_wait: float = 0.5,
         interactive_hedge_factor: float = 0.5,
+        quarantine: Optional[QuarantineTable] = None,
     ):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
@@ -116,8 +122,14 @@ class ReplicaPool:
         # costs an interactive request its SLO long before it costs a
         # bulk batch anything, so the latency-tier pays for redundancy
         self.interactive_hedge_factor = float(interactive_hedge_factor)
+        # query-of-death containment (ISSUE 12): one attribution table
+        # shared by every replica.  None = containment off (legacy pools
+        # requeue unboundedly); the engine detects the table and turns
+        # on digests + retry budgets.
+        self.quarantine = quarantine
         self.replicas: List[Replica] = [
-            Replica(i, runner_factory, policy=self.policy)
+            Replica(i, runner_factory, policy=self.policy,
+                    quarantine=quarantine)
             for i in range(n_replicas)
         ]
         self._lock = make_lock("ReplicaPool._lock")
@@ -296,15 +308,25 @@ class ReplicaPool:
         deadline: Optional[float] = None,
         model: Optional[str] = None,
         lane: Optional[str] = None,
+        digests: Optional[Tuple[str, ...]] = None,
+        budget: Optional[Any] = None,
     ) -> Dict[str, np.ndarray]:
         """Predict ``batch`` on some healthy replica: least-loaded pick,
         hedge past the timeout, requeue on drain, fail over on error.
         ``model`` keys the affinity and rides the dispatch down to the
         replica's runner; ``lane`` tightens the hedge for interactive
-        batches and feeds per-lane dispatch counters.  Raises
-        :class:`NoHealthyReplica` when the pool has no capacity, or the
-        last replica error after bounded failover."""
+        batches and feeds per-lane dispatch counters.  With containment
+        on, ``digests`` identifies the member requests and every
+        re-dispatch spends ``budget`` (RetriesExhausted ends the loop);
+        a quarantined digest raises :class:`PoisonBatch` and a trip that
+        implicated a multi-request batch raises :class:`BatchImplicated`
+        so the engine splits it instead of co-tripping the innocents to
+        K alongside the poison.  Raises :class:`NoHealthyReplica` when
+        the pool has no capacity, or the last replica error after
+        bounded failover."""
         bucket = tuple(batch["images"].shape[1:3])
+        digests = tuple(digests or ())
+        qt = self.quarantine
         t0 = time.monotonic()
         attempts = 0
         max_attempts = len(self.replicas) + 1
@@ -312,6 +334,10 @@ class ReplicaPool:
         exclude: Tuple[int, ...] = ()
         while attempts < max_attempts:
             attempts += 1
+            if qt is not None and digests:
+                bad = qt.first_quarantined(digests)
+                if bad is not None:
+                    raise PoisonBatch(bad, digests) from last_exc
             primary = self._pick(bucket, exclude, model=model)
             if primary is None and exclude:
                 # every sibling already failed this batch — retry the
@@ -331,7 +357,8 @@ class ReplicaPool:
                 self.dispatched += 1
                 if lane in self.dispatched_by_lane:
                     self.dispatched_by_lane[lane] += 1
-            d = primary.submit(batch, deadline, model=model, lane=lane)
+            d = primary.submit(batch, deadline, model=model, lane=lane,
+                               digests=digests)
             try:
                 out = d.future.result(timeout=self._hedge_s(deadline, lane))
                 self._done(t0)
@@ -340,11 +367,17 @@ class ReplicaPool:
                 with self._lock:
                     self.requeued += 1
                 last_exc = e
+                if d.implicated and len(digests) > 1:
+                    # this batch took the replica down; splitting it solo
+                    # pins the poison in one more trip
+                    raise BatchImplicated(digests, str(e)) from e
+                if budget is not None:
+                    budget.spend("requeue")
                 continue  # replica tripped mid-flight: requeue elsewhere
             except FutureTimeout:
                 out = self._race_hedge(
                     batch, bucket, deadline, primary, d, model=model,
-                    lane=lane,
+                    lane=lane, digests=digests, budget=budget,
                 )
                 if out is not None:
                     self._done(t0)
@@ -358,6 +391,10 @@ class ReplicaPool:
                 with self._lock:
                     self.failovers += 1
                 last_exc = e
+                if d.implicated and len(digests) > 1:
+                    raise BatchImplicated(digests, str(e)) from e
+                if budget is not None:
+                    budget.spend("failover")
                 exclude = exclude + (primary.index,)
         raise last_exc if last_exc is not None else NoHealthyReplica(
             "routing attempts exhausted"
@@ -376,11 +413,13 @@ class ReplicaPool:
         return None
 
     def _race_hedge(self, batch, bucket, deadline, primary, d, model=None,
-                    lane=None):
+                    lane=None, digests=(), budget=None):
         """Primary exceeded the hedge timeout: dispatch the same batch to
         a second replica and race.  Returns the first success, or None
         when both legs fail.  The losing leg's result is discarded by its
-        replica (resolve-once dispatch future → ``abandoned``)."""
+        replica (resolve-once dispatch future → ``abandoned``).  The
+        hedge duplicates the batch, so with containment on it spends the
+        retry budget like any other re-dispatch."""
         with self._lock:
             self.hedged += 1
         backup = self._pick(bucket, exclude=(primary.index,), model=model)
@@ -390,7 +429,10 @@ class ReplicaPool:
                 return d.future.result()
             except Exception:  # noqa: BLE001
                 return None
-        d2 = backup.submit(batch, deadline, model=model, lane=lane)
+        if budget is not None:
+            budget.spend("hedge")
+        d2 = backup.submit(batch, deadline, model=model, lane=lane,
+                           digests=tuple(digests or ()))
         futures = {d.future: "primary", d2.future: "hedge"}
         while futures:
             done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
@@ -447,6 +489,8 @@ class ReplicaPool:
         reg = self.registry
         if reg is not None:
             out["registry"] = reg.snapshot()
+        if self.quarantine is not None:
+            out["quarantine"] = self.quarantine.snapshot()
         return out
 
 

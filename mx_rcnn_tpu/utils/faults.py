@@ -43,6 +43,26 @@ attempts within a dispatch share the ordinal, so ``xN`` spans attempts).
                              in-flight batch is requeued, and it
                              rewarms/rejoins once the wedge releases)
 
+Poison-input injectors (ISSUE 12, query-of-death containment) are keyed
+by the *request digest* — the hex string ``serve.quarantine.request_digest``
+computes over the raw submitted image (a unique prefix is enough).  They
+fire inside a replica's predict whenever the dispatched batch contains a
+matching digest, which is what makes the poison follow the request
+through requeues, hedges, and isolation probes instead of striking a
+fixed (replica, ordinal) coordinate::
+
+    poison_fail@DIGEST[xN]     raise InjectedPredictFault whenever a
+                               batch containing DIGEST is predicted
+                               (unbounded = a deterministic query of
+                               death; x1 = a one-off coincidence the
+                               quarantine table must NOT blacklist)
+    poison_stall@DIGEST:SECS   sleep SECS (default 0.25 — past the hedge
+                               timeout, under the stall watchdog)
+    poison_wedge@DIGEST:SECS   sleep SECS (default 5.0 — past the stall
+                               watchdog: the replica trips, the digest
+                               is recorded as a suspect, and attribution
+                               drives it to quarantine)
+
 Swap-phase injectors (ISSUE 7) are keyed by the registry-wide swap
 ordinal (1-based: the Nth ``SwapController`` the registry launches, any
 model), or ``*`` for every swap.  Each fires once per swap at its
@@ -133,6 +153,9 @@ class InjectedDeviceFault(RuntimeError):
 # serve-phase kinds take the compound REPLICA.ORDINAL key
 _SERVE_KINDS = ("predict_fail", "predict_stall", "replica_wedge")
 
+# poison kinds are keyed by request digest (hex-prefix string match)
+_POISON_KINDS = ("poison_fail", "poison_stall", "poison_wedge")
+
 # swap-phase kinds, keyed by the 1-based registry-wide swap ordinal
 _SWAP_KINDS = {
     "verify": "swap_verify_fail",
@@ -154,6 +177,7 @@ _KNOWN_KINDS = frozenset(
         "stall",
     }
     | set(_SERVE_KINDS)
+    | set(_POISON_KINDS)
     | set(_SWAP_KINDS.values())
     | set(_DEVICE_KINDS)
 )
@@ -184,9 +208,12 @@ class _Registry:
 _registry: Optional[_Registry] = None
 
 
-def _parse_key(s: str):
+def _parse_key(s: str, kind: Optional[str] = None):
     """``R.B`` / ``R.*`` → (replica, ordinal|None); bare ``*`` → None
-    (match-any, the swap kinds); plain int otherwise."""
+    (match-any, the swap kinds); a raw hex-prefix string for the
+    digest-keyed poison kinds; plain int otherwise."""
+    if kind in _POISON_KINDS:
+        return s
     if "." in s:
         r, _, o = s.partition(".")
         return (int(r), None if o == "*" else int(o))
@@ -218,11 +245,12 @@ def _parse(spec: str) -> List[_Fault]:
             times = int(times_s)
         defaults = {"spike": 1e4, "stall": 5.0,
                     "predict_stall": 0.25, "replica_wedge": 5.0,
+                    "poison_stall": 0.25, "poison_wedge": 5.0,
                     "device_wedge": 8.0}
         out.append(
             _Fault(
                 kind=kind,
-                key=_parse_key(rest),
+                key=_parse_key(rest, kind),
                 times=times,
                 arg=float(arg_s) if arg_s is not None else defaults.get(kind, 0.0),
             )
@@ -321,6 +349,33 @@ def predict_fault(replica: int, ordinal: int) -> None:
         if f.kind == "predict_fail":
             raise InjectedPredictFault(
                 f"injected predict failure: replica {replica} batch {ordinal}"
+            )
+        time.sleep(f.arg)
+        return
+
+
+def poison_input(digests) -> None:
+    """Replica predict hook (``serve/replica.py``): strike any predict
+    whose batch carries a matching request digest.  ``digests`` is the
+    dispatch's tuple of member digests (empty when containment is off —
+    one env lookup, then a no-op).  The spec key is a hex prefix of the
+    full digest, so fault specs stay readable; the first matching
+    un-exhausted fault fires (raise for ``poison_fail``, sleep for
+    ``poison_stall`` / ``poison_wedge``)."""
+    reg = _active()
+    if reg is None or not digests:
+        return
+    for f in reg.faults:
+        if f.kind not in _POISON_KINDS or not isinstance(f.key, str):
+            continue
+        hit = next((d for d in digests if d and d.startswith(f.key)), None)
+        if hit is None:
+            continue
+        if not f.fire():
+            continue
+        if f.kind == "poison_fail":
+            raise InjectedPredictFault(
+                f"injected poison failure: digest {hit[:12]}"
             )
         time.sleep(f.arg)
         return

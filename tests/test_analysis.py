@@ -21,6 +21,7 @@ from mx_rcnn_tpu.analysis.rules_futures import ExactlyOnce
 from mx_rcnn_tpu.analysis.rules_hostcopy import HostCopyEscape, UseAfterDonate
 from mx_rcnn_tpu.analysis.rules_jit import JitPurity
 from mx_rcnn_tpu.analysis.rules_locks import LockOrder
+from mx_rcnn_tpu.analysis.rules_requeue import BoundedRequeue
 from mx_rcnn_tpu.analysis.rules_signals import SignalSafety
 
 REPO = Path(__file__).resolve().parents[1]
@@ -504,6 +505,86 @@ def test_r7_silent_on_flag_flip_handler():
     assert run_rule(R7_GOOD, SignalSafety()) == []
 
 
+# ---------------------------------------------------------------- R8
+
+R8_BAD_LOOP = """
+class Router:
+    def run(self, batch):
+        while True:
+            try:
+                d = self.replica.submit(batch)
+                return d.future.result()
+            except Exception:
+                continue
+"""
+
+R8_BAD_RETRY_FN = """
+class Engine:
+    def _resubmit(self, req):
+        self.batcher.submit(req)
+"""
+
+R8_GOOD_DIRECT_SPEND = """
+class Router:
+    def run(self, batch, budget):
+        while True:
+            try:
+                d = self.replica.submit(batch)
+                return d.future.result()
+            except Exception:
+                budget.spend("requeue")
+"""
+
+R8_GOOD_INDIRECT_SPEND = """
+class Engine:
+    def _charge(self, req):
+        req.budget.spend("resubmit")
+
+    def _resubmit(self, req):
+        self._charge(req)
+        self.batcher.submit(req)
+"""
+
+R8_GOOD_INTAKE = """
+def client(engine, im):
+    while True:
+        try:
+            return engine.submit(im)
+        except Exception:
+            continue
+"""
+
+SERVE_PATH = "mx_rcnn_tpu/serve/fx.py"
+
+
+def test_r8_fires_on_looped_requeue_without_budget():
+    fs = run_rule(R8_BAD_LOOP, BoundedRequeue(), path=SERVE_PATH)
+    assert len(fs) == 1 and fs[0].rule == "R8"
+    assert "inside a loop" in fs[0].message
+
+
+def test_r8_fires_in_retry_named_function():
+    fs = run_rule(R8_BAD_RETRY_FN, BoundedRequeue(), path=SERVE_PATH)
+    assert len(fs) == 1 and "retry path" in fs[0].message
+
+
+def test_r8_silent_when_budget_spent_directly():
+    assert run_rule(R8_GOOD_DIRECT_SPEND, BoundedRequeue(),
+                    path=SERVE_PATH) == []
+
+
+def test_r8_silent_when_spend_reached_through_helper():
+    assert run_rule(R8_GOOD_INDIRECT_SPEND, BoundedRequeue(),
+                    path=SERVE_PATH) == []
+
+
+def test_r8_silent_on_intake_submit_and_out_of_scope():
+    # engine.submit is intake, not re-dispatch — not a requeue receiver
+    assert run_rule(R8_GOOD_INTAKE, BoundedRequeue(), path=SERVE_PATH) == []
+    # same unbounded loop outside /serve/ is out of scope
+    assert run_rule(R8_BAD_LOOP, BoundedRequeue()) == []
+
+
 # ------------------------------------------------- suppression layers
 
 
@@ -739,3 +820,37 @@ def test_elastic_artifact_schema_guard(tmp_path):
     errs = " | ".join(check_bench_artifacts(tmp_path))
     assert "scenario 'wedge' missing" in errs
     assert "'lose_1_of_8' missing 'bit_identical'" in errs
+
+
+def test_poison_artifact_schema_guard(tmp_path):
+    """BENCH_poison_cpu.json must carry the four ISSUE 12 containment
+    claims — all true — plus a non-empty poison digest list and the
+    per-claim metric records."""
+    claims = {
+        "zero_healthy_lost": True,
+        "healthy_byte_identical": True,
+        "poison_quarantined_within_k": True,
+        "all_replicas_healthy": True,
+    }
+    good = {
+        "records": [
+            {"metric": f"serve_poison_{m}_r50", "value": 1}
+            for m in ("healthy_lost", "healthy_byte_identical",
+                      "quarantined_within_k", "replicas_healthy")
+        ],
+        "report": {"claims": dict(claims), "digests": ["abc123"]},
+    }
+    art = tmp_path / "BENCH_poison_cpu.json"
+    art.write_text(json.dumps(good))
+    assert check_bench_artifacts(tmp_path) == []
+
+    good["report"]["claims"]["healthy_byte_identical"] = False
+    del good["report"]["claims"]["all_replicas_healthy"]
+    good["report"]["digests"] = []
+    good["records"] = good["records"][1:]
+    art.write_text(json.dumps(good))
+    errs = " | ".join(check_bench_artifacts(tmp_path))
+    assert "'healthy_byte_identical' not true" in errs
+    assert "'all_replicas_healthy' missing" in errs
+    assert "digests empty" in errs
+    assert "no record metric 'serve_poison_healthy_lost*'" in errs
