@@ -167,8 +167,12 @@ class Replica:
         self.runner = runner_factory(self.index)
         self._lock = make_lock("Replica._lock")
         self._inbox: "queue.Queue[Optional[_Dispatch]]" = queue.Queue()
-        self._current: Optional[_Dispatch] = None
-        self._watchdog: Optional[threading.Timer] = None
+        # in-flight dispatches keyed by ordinal, each with its own stall
+        # watchdog (armed per dispatch, cancelled individually) — the
+        # serial loop holds at most one entry, but trip/attribution code
+        # treats the whole window uniformly
+        self._inflight: Dict[int, _Dispatch] = {}
+        self._watchdogs: Dict[int, threading.Timer] = {}
         self._stop = False
         self.state = ReplicaState.WARMING
         # health-monitor state
@@ -233,7 +237,7 @@ class Replica:
     def load(self) -> int:
         """Queued + in-flight dispatches (the least-loaded routing key)."""
         with self._lock:
-            return self._inbox.qsize() + (1 if self._current is not None else 0)
+            return self._inbox.qsize() + len(self._inflight)
 
     # ---------------------------------------------------------- dispatch
     def submit(
@@ -264,25 +268,38 @@ class Replica:
     def trip(self, reason: str,
              suspect: Optional[_Dispatch] = None) -> None:
         """Force DRAINING now (watchdog expiry, failure budget, or an
-        operator drain): fail the in-flight dispatch over, requeue-fail
+        operator drain): fail every in-flight dispatch over, requeue-fail
         everything queued, and let the worker run recovery.  Idempotent;
         callable from any thread.  ``suspect`` names the dispatch that
-        caused a failure-budget trip (the in-flight one is implicated by
-        default); its member digests are recorded in the pool's
-        quarantine table as attribution suspects.  Queued dispatches
-        were never running, so they drain *without* implication."""
+        caused a failure-budget trip (already out of the window — its
+        future resolved with the predict error); together with the whole
+        in-flight window it forms the trip's attribution suspects, and
+        every member digest lands in the pool's quarantine table in ONE
+        ``note_trip`` call (one trip event, however deep the window).
+        Queued dispatches were never running, so they drain *without*
+        implication."""
         with self._lock:
             if self.state in (ReplicaState.DRAINING, ReplicaState.RECOVERING):
                 return
             self._log_transition(ReplicaState.DRAINING, reason)
             self._trip_times.append(time.monotonic())
-            cur = suspect if suspect is not None else self._current
-        if cur is not None:
+            victims = list(self._inflight.values())
+            self._inflight.clear()
+            dogs = list(self._watchdogs.values())
+            self._watchdogs.clear()
+        for t in dogs:
+            t.cancel()
+        if suspect is not None and suspect not in victims:
+            victims.insert(0, suspect)
+        drained = ReplicaDrained(f"replica {self.index} draining ({reason})")
+        suspects: List[Any] = []
+        for cur in victims:
             # mark before resolving so the router's waiter can observe it
             cur.implicated = True
-        drained = ReplicaDrained(f"replica {self.index} draining ({reason})")
-        if cur is not None and cur.resolve(exc=drained):
-            self.requeued_out += 1
+            if cur.resolve(exc=drained):
+                self.requeued_out += 1
+            if cur.digests:
+                suspects.extend(self._suspect_list(cur))
         while True:
             try:
                 d = self._inbox.get_nowait()
@@ -290,9 +307,9 @@ class Replica:
                 break
             if d is not None and d.resolve(exc=drained):
                 self.requeued_out += 1
-        if cur is not None and cur.digests and self.quarantine is not None:
+        if suspects and self.quarantine is not None:
             self.quarantine.note_trip(
-                self._suspect_list(cur), replica=self.index, reason=reason
+                suspects, replica=self.index, reason=reason
             )
 
     def drain(self) -> None:
@@ -344,18 +361,25 @@ class Replica:
             self._serve(d)
 
     def _arm_watchdog(self, ordinal: int) -> None:
-        t = threading.Timer(
-            self.policy.stall_timeout,
-            lambda: self.trip(f"stall>{self.policy.stall_timeout:g}s"),
-        )
+        t = threading.Timer(self.policy.stall_timeout, self._watchdog_fire,
+                            args=(ordinal,))
         t.daemon = True
+        with self._lock:
+            if ordinal not in self._inflight:
+                return  # tripped between admission and arming
+            self._watchdogs[ordinal] = t
         t.start()
-        self._watchdog = t
 
-    def _disarm_watchdog(self) -> None:
-        if self._watchdog is not None:
-            self._watchdog.cancel()
-            self._watchdog = None
+    def _watchdog_fire(self, ordinal: int) -> None:
+        with self._lock:
+            self._watchdogs.pop(ordinal, None)
+        self.trip(f"stall>{self.policy.stall_timeout:g}s")
+
+    def _disarm_watchdog(self, ordinal: int) -> None:
+        with self._lock:
+            t = self._watchdogs.pop(ordinal, None)
+        if t is not None:
+            t.cancel()
 
     def _predict(self, batch, ordinal: int, attempt: int,
                  model: Optional[str] = None,
@@ -380,9 +404,9 @@ class Replica:
                 ))
                 self.requeued_out += 1
                 return
-            self._current = d
             d.ordinal = self._ordinal
             self._ordinal += 1
+            self._inflight[d.ordinal] = d
         self.dispatches += 1
         self._arm_watchdog(d.ordinal)
         t0 = time.monotonic()
@@ -394,18 +418,18 @@ class Replica:
                 )
             )
         except Exception as e:  # noqa: BLE001 — typed failover, never a drop
-            self._disarm_watchdog()
+            self._disarm_watchdog(d.ordinal)
             with self._lock:
-                self._current = None
+                self._inflight.pop(d.ordinal, None)
             self.failures += 1
             if not d.resolve(exc=e):
                 self.abandoned += 1
             self._note_failure(d.ordinal, dispatch=d)
             return
-        self._disarm_watchdog()
+        self._disarm_watchdog(d.ordinal)
         dt = time.monotonic() - t0
         with self._lock:
-            self._current = None
+            self._inflight.pop(d.ordinal, None)
         if not d.resolve(out):
             # the watchdog already failed this dispatch over (the batch
             # reran elsewhere); the late result is discarded, not served
