@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-fault swap slo poison pipeline elastic chaos integration-gate clean-native
+.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault swap slo poison pipeline elastic chaos integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -78,6 +78,16 @@ eval-bench:
 # zero recompiles after warmup, as JSON lines + the artifact file
 serve:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve --out BENCH_serve_cpu.json
+
+# overlapped-serving bench (ISSUE 13): split dispatch/complete predict
+# path with a bounded per-replica in-flight window, measured against a
+# calibrated stub device stall (model FLOPs would hide the overlap on
+# CPU).  Emits depth=1 vs depth=2 throughput + speedup, stub-exact
+# device-busy fraction, byte-identity, and the depth=2 fault matrix
+# (zero lost, zero steady-state recompiles) as the artifact
+serve-overlap:
+	JAX_PLATFORMS=cpu $(PY) bench.py --serve_overlap \
+	      --out BENCH_serve_overlap_cpu.json
 
 # fault-matrix serving bench (ISSUE 6): the same deterministic load
 # against a 3-replica health-gated pool under healthy / wedged-replica /

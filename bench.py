@@ -19,6 +19,7 @@ were O(2-5) imgs/sec/GPU).
 import argparse
 import dataclasses
 import json
+import threading
 import time
 
 import numpy as np
@@ -177,6 +178,7 @@ def bench_serve(
     linger_ms: float,
     small: bool = True,
     replicas: int = 1,
+    inflight_depth: int = 2,
 ) -> tuple:
     """Online-serving measurement: drive the dynamic-batching engine with
     the deterministic synthetic load generator and report latency,
@@ -197,7 +199,8 @@ def bench_serve(
     from mx_rcnn_tpu.serve.router import ReplicaPool
 
     _, _, _, sizes, factory = _serve_model(network, small, max_batch)
-    pool = ReplicaPool(factory, n_replicas=replicas)
+    pool = ReplicaPool(factory, n_replicas=replicas,
+                       inflight_depth=inflight_depth)
     with ServingEngine(pool, max_linger=linger_ms / 1000.0) as engine:
         report = run_load(
             engine, num_requests=requests, concurrency=concurrency,
@@ -263,6 +266,324 @@ def _dets_equal(a, b) -> bool:
         if x.tobytes() != y.tobytes():
             return False
     return True
+
+
+class _OverlapStubRunner:
+    """Split-capable runner stub with a CALIBRATED device-stall model
+    (the ``bench_eval --stub_device_ms`` idiom, applied to serving).
+
+    The real overlap win is invisible on a 1-core CPU — model FLOPs
+    dwarf the fetch — so the stub models the three phases the split
+    predict path actually reorders, each as an explicit stall:
+
+    * ``dispatch`` sleeps ``h2d_ms`` (host-blocking staging copy), then
+      books ``device_ms`` of modeled device time onto a single-device
+      timeline (``_device_free_t``): compute for batch N+1 queues
+      behind batch N exactly like one accelerator's stream.
+    * ``complete`` blocks until the handle's modeled ready time, then
+      sleeps ``fetch_ms`` (the D2H output copy + host postprocess).
+
+    Serial cost per batch is ``h2d + device + fetch``; at depth 2 the
+    fetch of batch N overlaps the staging + compute of batch N+1, so
+    steady-state cost drops to ``max(device, h2d + fetch)`` — the same
+    algebra as the train pipeline's ROOFLINE entry.  Outputs stay the
+    FakeRunner digest (a pure function of the slot pixels), so the
+    depth-1 vs depth-2 byte-identity check is exact, and
+    ``device_busy_s`` gives a stub-exact device-busy fraction to put
+    next to the conservative estimate the replicas export.
+    """
+
+    LADDER = ((32, 32), (48, 64))
+
+    def __init__(self, index: int = 0, h2d_ms: float = 10.0,
+                 device_ms: float = 60.0, fetch_ms: float = 25.0):
+        from mx_rcnn_tpu.serve.buckets import BucketLadder, CompileCache
+
+        self.index = index
+        self.h2d_s = h2d_ms / 1000.0
+        self.device_s = device_ms / 1000.0
+        self.fetch_s = fetch_ms / 1000.0
+        self.ladder = BucketLadder(self.LADDER)
+        self.max_batch = 2
+        self.cfg = None
+        self.compile_cache = CompileCache()
+        self._lock = threading.Lock()
+        self._device_free_t = 0.0
+        self.device_busy_s = 0.0
+
+    def warmup(self) -> int:
+        for bh, bw in self.ladder:
+            self.compile_cache.record(((self.max_batch, bh, bw, 3), "f32"))
+        return self.compile_cache.misses
+
+    def make_request(self, im, deadline=None):
+        from mx_rcnn_tpu.serve.batcher import Request
+
+        h, w = im.shape[:2]
+        bh, bw = self.ladder.select(h, w)
+        canvas = np.zeros((bh, bw, 3), np.float32)
+        canvas[:h, :w] = im
+        return Request(
+            image=canvas,
+            im_info=np.array([h, w, 1.0], np.float32),
+            orig_hw=(h, w),
+            bucket=(bh, bw),
+            deadline=deadline,
+        )
+
+    def assemble(self, requests):
+        images = [r.image for r in requests]
+        while len(images) < self.max_batch:
+            images.append(images[0])
+        return {
+            "images": np.stack(images),
+            "im_info": np.stack(
+                [r.im_info for r in requests]
+                + [requests[0].im_info] * (self.max_batch - len(requests))
+            ),
+            "orig_hw": np.array(
+                [r.orig_hw for r in requests]
+                + [requests[0].orig_hw] * (self.max_batch - len(requests))
+            ),
+        }
+
+    def dispatch(self, batch, model=None):
+        time.sleep(self.h2d_s)  # host-blocking H2D staging
+        self.compile_cache.record((batch["images"].shape, "f32"))
+        im = batch["images"].astype(np.float64)
+        out = {
+            "digest": np.stack(
+                [im.sum(axis=(1, 2, 3)), (im * im).sum(axis=(1, 2, 3))],
+                axis=1,
+            )
+        }
+        with self._lock:
+            start = max(time.monotonic(), self._device_free_t)
+            ready = start + self.device_s
+            self._device_free_t = ready
+            self.device_busy_s += self.device_s
+        return {"out": out, "ready_t": ready}
+
+    def complete(self, handle):
+        delay = handle["ready_t"] - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)  # modeled device compute still running
+        time.sleep(self.fetch_s)  # D2H fetch + host postprocess
+        return handle["out"]
+
+    def run(self, batch, model=None):
+        return self.complete(self.dispatch(batch, model=model))
+
+    def detections_for(self, out, batch, index, orig_hw=None, thresh=None):
+        return [out["digest"][index].copy()]
+
+
+# overlap fault matrix (depth=2, 2 replicas): one transient predict
+# failure absorbed by the retry tail, and a hard stall that trips the
+# watchdog while TWO dispatches are in flight — both must requeue
+_OVERLAP_FAULT_SCENARIOS = {
+    "predict_fail": "predict_fail@0.2x1",
+    "stall_two_inflight": "predict_stall@0.5:1.5",
+}
+
+
+def bench_serve_overlap(
+    requests: int = 48,
+    concurrency: int = 8,
+    linger_ms: float = 5.0,
+    h2d_ms: float = 10.0,
+    device_ms: float = 60.0,
+    fetch_ms: float = 25.0,
+) -> tuple:
+    """Overlapped-serving bench (ISSUE 13 acceptance evidence).
+
+    Three legs over the :class:`_OverlapStubRunner` timing model:
+
+    1. depth=1 on a 1-replica pool — the serial reference;
+    2. depth=2 on a 1-replica pool — same load, same seed; claims
+       throughput >= 1.3x the serial leg with byte-identical
+       detections, and reports both the stub-exact device-busy
+       fraction (``device_busy_s / wall``) and the conservative
+       estimate the replica's :class:`OverlapStats` exports;
+    3. the overlap fault matrix at depth=2 on 2 replicas — zero lost
+       requests per scenario, ok detections byte-identical to the
+       healthy depth-2 leg, and zero steady-state recompiles (a second
+       traffic wave after recovery adds no compile-cache misses).
+    """
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.loadgen import run_load
+    from mx_rcnn_tpu.serve.replica import HealthPolicy
+    from mx_rcnn_tpu.serve.router import ReplicaPool
+    from mx_rcnn_tpu.utils import faults
+
+    sizes = ((24, 24), (32, 48), (16, 16))
+
+    def factory(index: int) -> _OverlapStubRunner:
+        return _OverlapStubRunner(
+            index, h2d_ms=h2d_ms, device_ms=device_ms, fetch_ms=fetch_ms
+        )
+
+    def throughput_leg(depth: int):
+        pool = ReplicaPool(factory, n_replicas=1, inflight_depth=depth)
+        engine = ServingEngine(
+            pool, max_linger=linger_ms / 1000.0, in_flight=4
+        )
+        t0 = time.monotonic()
+        with engine:
+            report = run_load(
+                engine, num_requests=requests, concurrency=concurrency,
+                sizes=sizes, seed=0, collect=True,
+            )
+        wall = time.monotonic() - t0
+        busy = sum(r.runner.device_busy_s for r in pool.replicas)
+        snap = pool.snapshot()
+        pool.close()
+        results = report.pop("_results")
+        return {
+            "inflight_depth": depth,
+            "imgs_per_sec": report["imgs_per_sec"],
+            "p50_ms": report["engine"]["latency"]["e2e"]["p50_ms"],
+            "p99_ms": report["engine"]["latency"]["e2e"]["p99_ms"],
+            "compile_misses": report["engine"]["compile"]["misses"],
+            "device_busy_fraction": round(busy / wall, 4),
+            "overlap": snap["overlap"],
+        }, {i: r for i, (kind, r) in results.items() if kind == "ok"}
+
+    depth1, ok1 = throughput_leg(1)
+    depth2, ok2 = throughput_leg(2)
+    speedup = round(depth2["imgs_per_sec"] / depth1["imgs_per_sec"], 3)
+    byte_identical = (
+        set(ok1) == set(ok2)
+        and all(_dets_equal(ok1[i], ok2[i]) for i in ok1)
+    )
+
+    # ---- fault matrix leg: depth=2, 2 replicas, watchdog sized so the
+    # injected 1.5 s stall trips it with the window full
+    import os
+
+    policy = HealthPolicy(stall_timeout=0.4, fail_threshold=2,
+                          breaker_backoff=0.05, breaker_max_backoff=0.5)
+    fault = {}
+    prior = os.environ.get(faults.ENV_VAR)
+    try:
+        for name, spec in _OVERLAP_FAULT_SCENARIOS.items():
+            os.environ[faults.ENV_VAR] = spec
+            faults.reset()
+            pool = ReplicaPool(factory, n_replicas=2, inflight_depth=2,
+                               policy=policy)
+            engine = ServingEngine(
+                pool, max_linger=linger_ms / 1000.0, in_flight=4
+            )
+            with engine:
+                report = run_load(
+                    engine, num_requests=requests,
+                    concurrency=concurrency, sizes=sizes, seed=0,
+                    collect=True,
+                )
+                # wait out any drain -> rewarm -> rejoin before the
+                # steady-state wave (stub warmup is instant; bounded)
+                t_wait = time.monotonic()
+                while time.monotonic() - t_wait < 30.0:
+                    reps = pool.snapshot()["replicas"]
+                    if all(r["state"] == "healthy" for r in reps):
+                        break
+                    time.sleep(0.05)
+                misses_settled = engine.snapshot()["compile"]["misses"]
+                report2 = run_load(
+                    engine, num_requests=requests,
+                    concurrency=concurrency, sizes=sizes, seed=0,
+                )
+            pool_snap = pool.snapshot()
+            pool.close()
+            results = report.pop("_results")
+            ok = {i: r for i, (kind, r) in results.items() if kind == "ok"}
+            out1, out2 = report["outcomes"], report2["outcomes"]
+            lost = (
+                requests - (out1["ok"] + out1["deadline"] + out1["error"])
+            ) + (
+                requests - (out2["ok"] + out2["deadline"] + out2["error"])
+            )
+            fault[name] = {
+                "spec": spec,
+                "lost_requests": lost,
+                "detections_match_healthy": all(
+                    _dets_equal(ok2[i], ok[i]) for i in ok if i in ok2
+                ),
+                "steady_state_compile_misses": (
+                    report2["engine"]["compile"]["misses"] - misses_settled
+                ),
+                "requeued": sum(
+                    rep["requeued_out"] for rep in pool_snap["replicas"]
+                ),
+                "overlap": pool_snap["overlap"],
+            }
+    finally:
+        if prior is None:
+            os.environ.pop(faults.ENV_VAR, None)
+        else:
+            os.environ[faults.ENV_VAR] = prior
+        faults.reset()
+
+    zero_lost = all(s["lost_requests"] == 0 for s in fault.values())
+    zero_recompiles = all(
+        s["steady_state_compile_misses"] == 0 for s in fault.values()
+    )
+    records = [
+        {"metric": "serve_overlap_imgs_per_sec_depth1",
+         "value": depth1["imgs_per_sec"], "unit": "imgs/sec",
+         "vs_baseline": None},
+        {"metric": "serve_overlap_imgs_per_sec_depth2",
+         "value": depth2["imgs_per_sec"], "unit": "imgs/sec",
+         "vs_baseline": None},
+        {"metric": "serve_overlap_speedup",
+         "value": speedup, "unit": "x", "vs_baseline": None},
+        {"metric": "serve_overlap_device_busy_fraction_depth1",
+         "value": depth1["device_busy_fraction"], "unit": "fraction",
+         "vs_baseline": None},
+        {"metric": "serve_overlap_device_busy_fraction_depth2",
+         "value": depth2["device_busy_fraction"], "unit": "fraction",
+         "vs_baseline": None},
+        {"metric": "serve_overlap_fetch_stall_ms_depth1",
+         "value": depth1["overlap"]["fetch_stall_ms"], "unit": "ms",
+         "vs_baseline": None},
+        {"metric": "serve_overlap_fetch_stall_ms_depth2",
+         "value": depth2["overlap"]["fetch_stall_ms"], "unit": "ms",
+         "vs_baseline": None},
+        {"metric": "serve_overlap_hidden_host_ms_depth2",
+         "value": depth2["overlap"]["overlap_hidden_host_ms"], "unit": "ms",
+         "vs_baseline": None},
+        {"metric": "serve_overlap_inflight_hw_depth2",
+         "value": depth2["overlap"]["inflight_hw"], "unit": "dispatches",
+         "vs_baseline": None},
+        {"metric": "serve_overlap_byte_identical",
+         "value": int(byte_identical), "unit": "bool", "vs_baseline": None},
+        {"metric": "serve_overlap_fault_lost",
+         "value": sum(s["lost_requests"] for s in fault.values()),
+         "unit": "requests", "vs_baseline": None},
+        {"metric": "serve_overlap_steady_state_compile_misses",
+         "value": sum(
+             s["steady_state_compile_misses"] for s in fault.values()
+         ),
+         "unit": "compiles", "vs_baseline": None},
+    ]
+    report = {
+        "stub": {"h2d_ms": h2d_ms, "device_ms": device_ms,
+                 "fetch_ms": fetch_ms},
+        "requests": requests,
+        "concurrency": concurrency,
+        "depth1": depth1,
+        "depth2": depth2,
+        "speedup": speedup,
+        "byte_identical": byte_identical,
+        "fault": fault,
+        "claims": {
+            "speedup_ge_1_3": speedup >= 1.3,
+            "byte_identical": byte_identical,
+            "zero_lost_under_faults": zero_lost,
+            "zero_steady_state_recompiles": zero_recompiles,
+        },
+    }
+    return records, report
 
 
 def bench_serve_slo(
@@ -1759,6 +2080,23 @@ def main():
     ap.add_argument("--serve_replicas", type=int, default=1,
                     help="replica-pool size for --serve (1 = the "
                          "no-regression case) / --serve_fault (min 3)")
+    ap.add_argument("--inflight_depth", type=int, default=2,
+                    help="per-replica in-flight dispatch window for "
+                         "--serve (1 = the serial path; results are "
+                         "byte-identical at any depth)")
+    ap.add_argument(
+        "--serve_overlap", action="store_true",
+        help="overlapped-serving bench on a calibrated stub device "
+             "stall: depth=1 vs depth=2 throughput + byte-identity, "
+             "device-busy fraction, and the depth=2 fault matrix "
+             "(zero lost, zero steady-state recompiles)",
+    )
+    ap.add_argument("--overlap_device_ms", type=float, default=60.0,
+                    help="stub device compute per batch for "
+                         "--serve_overlap")
+    ap.add_argument("--overlap_fetch_ms", type=float, default=25.0,
+                    help="stub D2H fetch + host postprocess per batch "
+                         "for --serve_overlap")
     ap.add_argument(
         "--serve_fault", action="store_true",
         help="fault-matrix serving bench: healthy vs wedged vs flapping "
@@ -1936,6 +2274,20 @@ def main():
                 json.dump({"records": records, "report": report}, f, indent=1)
         return
 
+    if args.serve_overlap:
+        records, report = bench_serve_overlap(
+            requests=args.serve_requests,
+            concurrency=args.serve_concurrency // 2 or 8,
+            device_ms=args.overlap_device_ms,
+            fetch_ms=args.overlap_fetch_ms,
+        )
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
+
     if args.serve_fault:
         network = "resnet50" if args.network == "resnet" else args.network
         records, report = bench_serve_fault(
@@ -1956,6 +2308,7 @@ def main():
             network, args.serve_requests, args.serve_concurrency,
             args.serve_max_batch, args.serve_linger_ms,
             small=not args.serve_full, replicas=args.serve_replicas,
+            inflight_depth=args.inflight_depth,
         )
         for rec in records:
             print(json.dumps(rec), flush=True)

@@ -146,6 +146,56 @@ def _check_poison_schema(name: str, doc: dict) -> List[str]:
     return errors
 
 
+# the overlap artifact must keep proving the four ISSUE 13 acceptance
+# claims: the depth-2 speedup against the calibrated stub stall, the
+# byte-identity of detections across depths, and the fault-matrix
+# invariants (no request lost, no steady-state recompile) at depth=2
+_OVERLAP_CLAIMS = (
+    "speedup_ge_1_3", "byte_identical",
+    "zero_lost_under_faults", "zero_steady_state_recompiles",
+)
+_OVERLAP_METRIC_PREFIXES = (
+    "serve_overlap_speedup",
+    "serve_overlap_byte_identical",
+    "serve_overlap_fault_lost",
+    "serve_overlap_steady_state_compile_misses",
+)
+
+
+def _check_overlap_schema(name: str, doc: dict) -> List[str]:
+    errors = []
+    report = doc.get("report") if isinstance(doc, dict) else None
+    if not isinstance(report, dict):
+        return [f"bench artifact {name}: missing report object"]
+    claims = report.get("claims")
+    if not isinstance(claims, dict):
+        return [f"bench artifact {name}: report.claims missing"]
+    for c in _OVERLAP_CLAIMS:
+        if c not in claims:
+            errors.append(f"bench artifact {name}: claim '{c}' missing")
+        elif claims[c] is not True:
+            errors.append(f"bench artifact {name}: claim '{c}' not true")
+    for leg in ("depth1", "depth2"):
+        leg_doc = report.get(leg)
+        if not isinstance(leg_doc, dict) \
+                or "device_busy_fraction" not in leg_doc:
+            errors.append(
+                f"bench artifact {name}: report.{leg}.device_busy_fraction "
+                f"missing — the overlap claim has no utilization evidence"
+            )
+    metrics = {
+        r.get("metric", "")
+        for r in doc.get("records", [])
+        if isinstance(r, dict)
+    }
+    for prefix in _OVERLAP_METRIC_PREFIXES:
+        if not any(m.startswith(prefix) for m in metrics):
+            errors.append(
+                f"bench artifact {name}: no record metric '{prefix}*'"
+            )
+    return errors
+
+
 def check_bench_artifacts(root: Path) -> List[str]:
     errors = []
     for f in sorted(root.glob("BENCH_*.json")):
@@ -163,6 +213,8 @@ def check_bench_artifacts(root: Path) -> List[str]:
             errors += _check_slo_schema(f.name, doc)
         if f.name == "BENCH_poison_cpu.json":
             errors += _check_poison_schema(f.name, doc)
+        if f.name == "BENCH_serve_overlap_cpu.json":
+            errors += _check_overlap_schema(f.name, doc)
     return errors
 
 

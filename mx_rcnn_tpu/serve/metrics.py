@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -100,6 +101,85 @@ class LatencyHistogram:
             "p99_ms": round(self.percentile(99), 3) if self.count else None,
             "max_ms": round(self.max_ms, 3) if self.count else None,
         }
+
+
+class OverlapStats:
+    """Per-replica overlapped-execution counters (ISSUE 13).
+
+    A replica with a split-capable runner keeps up to ``inflight_depth``
+    dispatches outstanding; these counters are the evidence of what that
+    window bought:
+
+    * ``inflight_hw`` — high-water mark of the in-flight window;
+    * ``fetch_stall_ms`` — total wall time the worker blocked in
+      ``complete()`` (device finish + D2H);
+    * ``overlap_hidden_host_ms`` — host time (H2D staging and output
+      fetches) spent while ANOTHER dispatch was in flight, i.e. the host
+      gap the window actually hid behind device compute;
+    * ``device_busy_fraction`` — 1 minus the fraction of the activity
+      window spent fetching with NOTHING else in flight.  A sole
+      in-flight fetch is the serial loop's signature device-idle gap;
+      with depth ≥ 2 a sibling dispatch covers it, so the fraction
+      approaches 1.  (The device may still be computing the batch being
+      fetched, so this is a conservative lower bound, not a device-side
+      trace.)
+
+    All methods are O(1) and lock-protected; ``note_depth`` is called at
+    every window size change, ``note_fetch`` once per ``complete()``.
+    """
+
+    def __init__(self):
+        self._lock = make_lock("OverlapStats._lock")
+        self.inflight_hw = 0
+        self.fetches = 0
+        self.fetch_stall_s = 0.0
+        self.hidden_host_s = 0.0
+        self.idle_fetch_s = 0.0   # fetch time with an otherwise-empty window
+        self._t0: Optional[float] = None   # first dispatch ever
+        self._t_last: Optional[float] = None
+
+    def note_depth(self, depth: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if depth > 0 and self._t0 is None:
+                self._t0 = now
+            if self._t0 is not None:
+                self._t_last = now
+            if depth > self.inflight_hw:
+                self.inflight_hw = depth
+
+    def note_fetch(self, seconds: float, hidden: bool) -> None:
+        s = max(float(seconds), 0.0)
+        with self._lock:
+            self.fetches += 1
+            self.fetch_stall_s += s
+            if hidden:
+                self.hidden_host_s += s
+            else:
+                self.idle_fetch_s += s
+
+    def note_hidden(self, seconds: float) -> None:
+        with self._lock:
+            self.hidden_host_s += max(float(seconds), 0.0)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            wall = (
+                self._t_last - self._t0
+                if self._t0 is not None and self._t_last is not None
+                else 0.0
+            )
+            busy = (
+                round(1.0 - self.idle_fetch_s / wall, 4)
+                if wall > 0 else None
+            )
+            return {
+                "inflight_hw": self.inflight_hw,
+                "fetches": self.fetches,
+                "fetch_stall_ms": round(self.fetch_stall_s * 1e3, 3),
+                "overlap_hidden_host_ms": round(self.hidden_host_s * 1e3, 3),
+                "device_busy_fraction": busy,
+            }
 
 
 class ServeMetrics:

@@ -54,6 +54,18 @@ is called once per predict attempt, so every path above is
 deterministically reproducible on CPU (``predict_fail`` / ``predict_stall``
 / ``replica_wedge`` keyed by replica index and batch ordinal).
 
+Overlapped execution (ISSUE 13): with a split-capable runner
+(``dispatch``/``complete`` halves, the real :class:`ServeRunner`) the
+worker keeps up to ``inflight_depth`` dispatches outstanding — batch
+N+1's H2D staging and device compute overlap batch N's fetch and host
+postprocess.  Every dispatch still carries its own stall watchdog and
+resolves exactly once; a trip fails the WHOLE in-flight window over
+(requeue, never drop) and records every windowed digest as one combined
+quarantine suspect list.  Depth adds no jit signatures (same bucket,
+same ``max_batch`` pad) and depth=1 is byte-identical to the serial
+path (``run == complete ∘ dispatch``).  Split-less runners (legacy
+fakes) always serve serially.
+
 A note on hard wedges: the watchdog fails the *dispatch* over instantly,
 but the worker thread itself stays parked inside the native call until
 the runtime returns — recovery (and rejoin) begins at that point.  A
@@ -69,6 +81,7 @@ import logging
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -77,7 +90,7 @@ import numpy as np
 
 from mx_rcnn_tpu.analysis.lockcheck import make_lock
 from mx_rcnn_tpu.core.resilience import RetryPolicy, make_retry_policy
-from mx_rcnn_tpu.serve.metrics import LatencyHistogram
+from mx_rcnn_tpu.serve.metrics import LatencyHistogram, OverlapStats
 from mx_rcnn_tpu.utils import faults
 
 logger = logging.getLogger(__name__)
@@ -135,6 +148,11 @@ class _Dispatch:
     lane: Optional[str] = None   # SLO class tag (observability only)
     digests: Tuple[str, ...] = ()  # member request digests (containment)
     implicated: bool = False     # this dispatch's digests were trip suspects
+    # overlapped-path state: the device handle from the dispatch half, or
+    # the exception it raised (settled at _finish time, in window order)
+    handle: Any = None
+    error: Optional[BaseException] = None
+    t0: float = 0.0              # dispatch-half start (latency accounting)
 
     def resolve(self, result=None, exc: Optional[BaseException] = None) -> bool:
         """Set the future if still unset; False when it already resolved
@@ -159,9 +177,15 @@ class Replica:
         policy: Optional[HealthPolicy] = None,
         name: str = "replica",
         quarantine: Optional[Any] = None,
+        inflight_depth: int = 2,
     ):
         self.index = int(index)
         self.policy = policy or HealthPolicy()
+        # bounded in-flight window for split-capable runners (ISSUE 13):
+        # up to this many dispatches outstanding, so batch N+1's staging
+        # and device compute overlap batch N's fetch.  Runners without
+        # dispatch/complete halves always serve serially (depth 1).
+        self.inflight_depth = max(1, int(inflight_depth))
         self.quarantine = quarantine  # pool-shared QuarantineTable (or None)
         self._factory = runner_factory
         self.runner = runner_factory(self.index)
@@ -184,6 +208,7 @@ class Replica:
         # observability (read under no lock by snapshots: int/float writes
         # are atomic enough for counters; the transition log is locked)
         self.latency = LatencyHistogram()
+        self.overlap = OverlapStats()
         self.transitions: List[Dict[str, Any]] = []
         self.dispatches = 0
         self.failures = 0
@@ -238,6 +263,19 @@ class Replica:
         """Queued + in-flight dispatches (the least-loaded routing key)."""
         with self._lock:
             return self._inbox.qsize() + len(self._inflight)
+
+    @property
+    def _split(self) -> bool:
+        """The current runner exposes the dispatch/complete halves."""
+        r = self.runner
+        return hasattr(r, "dispatch") and hasattr(r, "complete")
+
+    def depth(self) -> int:
+        """Effective in-flight window: ``inflight_depth`` with a
+        split-capable runner, else 1 (the serial path).  The router's
+        hedging reads this — a dispatch waiting behind pipelined work on
+        a depth-k replica is not replica silence."""
+        return self.inflight_depth if self._split else 1
 
     # ---------------------------------------------------------- dispatch
     def submit(
@@ -345,20 +383,157 @@ class Replica:
     # ------------------------------------------------------------ worker
     def _loop(self) -> None:
         self._recover(initial=True)
+        # local in-flight window, dispatch order; entries mirror
+        # self._inflight (the dict is the trip/attribution view, the
+        # deque is the completion order)
+        pending: "deque[_Dispatch]" = deque()
         while not self._stop:
             if self.state is ReplicaState.DRAINING:
+                # trip() already failed every windowed dispatch over
+                pending.clear()
                 self._recover()
                 continue
-            if self.state is ReplicaState.DEGRADED and self._inbox.empty():
+            if (
+                self.state is ReplicaState.DEGRADED
+                and not pending
+                and self._inbox.empty()
+            ):
                 self._probe()
                 continue
-            try:
-                d = self._inbox.get(timeout=0.02)
-            except queue.Empty:
+            if not self._split:
+                # split-less runner (legacy fakes): the serial path
+                try:
+                    d = self._inbox.get(timeout=0.02)
+                except queue.Empty:
+                    continue
+                if d is None:
+                    break
+                self._serve(d)
                 continue
-            if d is None:
+            # overlapped path: top the window up to depth, then finish
+            # the oldest entry — batch N+1's dispatch half (staging +
+            # async forward) runs before batch N's fetch blocks the host
+            sentinel = False
+            while len(pending) < self.inflight_depth:
+                try:
+                    d = (
+                        self._inbox.get(timeout=0.02)
+                        if not pending
+                        else self._inbox.get_nowait()
+                    )
+                except queue.Empty:
+                    break
+                if d is None:
+                    sentinel = True
+                    break
+                entry = self._begin(d)
+                if entry is not None:
+                    pending.append(entry)
+            if sentinel:
+                # stop() trips before posting the sentinel, so windowed
+                # entries were already failed over
                 break
-            self._serve(d)
+            if pending:
+                entry = pending.popleft()
+                self._finish(entry)
+
+    def _begin(self, d: _Dispatch) -> Optional[_Dispatch]:
+        """Dispatch half of one windowed entry: admission + ordinal under
+        the lock, watchdog armed, then the async device dispatch through
+        the fault-injectable path.  A dispatch-half failure is recorded
+        on the entry and settled at :meth:`_finish` time, in window
+        order, so retries and failure attribution stay ordered.  Returns
+        None when the replica is no longer servable (the dispatch was
+        failed over)."""
+        with self._lock:
+            if self._stop or self.state not in (
+                ReplicaState.HEALTHY, ReplicaState.DEGRADED
+            ):
+                d.resolve(exc=ReplicaDrained(
+                    f"replica {self.index} is {self.state.value}"
+                ))
+                self.requeued_out += 1
+                return None
+            d.ordinal = self._ordinal
+            self._ordinal += 1
+            self._inflight[d.ordinal] = d
+            depth_now = len(self._inflight)
+        self.dispatches += 1
+        self.overlap.note_depth(depth_now)
+        self._arm_watchdog(d.ordinal)
+        d.t0 = time.monotonic()
+        try:
+            faults.predict_fault(self.index, d.ordinal)
+            faults.poison_input(d.digests)
+            if d.model is None:
+                d.handle = self.runner.dispatch(d.batch)
+            else:
+                d.handle = self.runner.dispatch(d.batch, model=d.model)
+            if depth_now > 1:
+                # this staging/dispatch host work ran while another
+                # dispatch was in flight: the window hid it
+                self.overlap.note_hidden(time.monotonic() - d.t0)
+        except Exception as e:  # noqa: BLE001 — settled at _finish
+            d.error = e
+        return d
+
+    def _retry_tail(self, d: _Dispatch, first_exc: BaseException):
+        """In-place retries for a windowed dispatch whose first attempt
+        (either half) failed: the remaining ``policy.retry`` attempts run
+        as BLOCKING full predicts, exactly the serial path's tail — the
+        window is not refilled around a failing batch."""
+        p = self.policy.retry
+        tries = max(1, p.tries)
+        last = first_exc
+        for attempt in range(1, tries):
+            if p.delay:
+                time.sleep(p.delay * p.backoff ** (attempt - 1))
+            try:
+                return self._predict(d.batch, d.ordinal, attempt,
+                                     model=d.model, digests=d.digests)
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                last = e
+        raise last
+
+    def _finish(self, d: _Dispatch) -> None:
+        """Completion half: force the oldest windowed dispatch's outputs
+        (``runner.complete`` under the ``host_copy`` discipline), resolve
+        its future exactly once, and feed the health monitor — the same
+        success/failure bookkeeping as the serial path."""
+        try:
+            if d.error is not None:
+                raise d.error
+            hidden = len(self._inflight) > 1  # a sibling covers this fetch
+            t_f = time.monotonic()
+            out = self.runner.complete(d.handle)
+            self.overlap.note_fetch(time.monotonic() - t_f, hidden=hidden)
+        except Exception as first:  # noqa: BLE001 — in-place retry tail
+            try:
+                out = self._retry_tail(d, first)
+            except Exception as e:  # noqa: BLE001 — typed failover
+                self._disarm_watchdog(d.ordinal)
+                with self._lock:
+                    self._inflight.pop(d.ordinal, None)
+                    depth_now = len(self._inflight)
+                self.overlap.note_depth(depth_now)
+                self.failures += 1
+                if not d.resolve(exc=e):
+                    self.abandoned += 1
+                self._note_failure(d.ordinal, dispatch=d)
+                return
+        self._disarm_watchdog(d.ordinal)
+        dt = time.monotonic() - d.t0
+        with self._lock:
+            self._inflight.pop(d.ordinal, None)
+            depth_now = len(self._inflight)
+        self.overlap.note_depth(depth_now)
+        if not d.resolve(out):
+            # the watchdog already failed this dispatch over (the batch
+            # reran elsewhere); the late result is discarded, not served
+            self.abandoned += 1
+            return
+        self.latency.record(dt)
+        self._note_success(dt, d.ordinal)
 
     def _arm_watchdog(self, ordinal: int) -> None:
         t = threading.Timer(self.policy.stall_timeout, self._watchdog_fire,
@@ -674,6 +849,7 @@ class Replica:
         return {
             "index": self.index,
             "state": state,
+            "inflight_depth": self.depth(),
             "dispatches": self.dispatches,
             "failures": self.failures,
             "retried": self.retried,
@@ -693,5 +869,6 @@ class Replica:
                 else None
             ),
             "latency": self.latency.snapshot(),
+            "overlap": self.overlap.snapshot(),
             "transitions": transitions,
         }

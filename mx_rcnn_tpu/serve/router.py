@@ -111,6 +111,7 @@ class ReplicaPool:
         no_healthy_wait: float = 0.5,
         interactive_hedge_factor: float = 0.5,
         quarantine: Optional[QuarantineTable] = None,
+        inflight_depth: int = 2,
     ):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
@@ -118,6 +119,10 @@ class ReplicaPool:
         self.hedge_timeout = float(hedge_timeout)
         self.min_hedge_timeout = float(min_hedge_timeout)
         self.no_healthy_wait = float(no_healthy_wait)
+        # per-replica in-flight window (ISSUE 13): split-capable runners
+        # keep up to this many dispatches outstanding; legacy fakes
+        # ignore it (their replicas serve serially)
+        self.inflight_depth = max(1, int(inflight_depth))
         # interactive batches hedge this much sooner: a straggler replica
         # costs an interactive request its SLO long before it costs a
         # bulk batch anything, so the latency-tier pays for redundancy
@@ -129,7 +134,8 @@ class ReplicaPool:
         self.quarantine = quarantine
         self.replicas: List[Replica] = [
             Replica(i, runner_factory, policy=self.policy,
-                    quarantine=quarantine)
+                    quarantine=quarantine,
+                    inflight_depth=self.inflight_depth)
             for i in range(n_replicas)
         ]
         self._lock = make_lock("ReplicaPool._lock")
@@ -284,12 +290,23 @@ class ReplicaPool:
         return best
 
     def _hedge_s(
-        self, deadline: Optional[float], lane: Optional[str] = None
+        self,
+        deadline: Optional[float],
+        lane: Optional[str] = None,
+        ahead: int = 0,
     ) -> float:
         """Half the remaining deadline budget, clamped into
         [min_hedge_timeout, hedge_timeout] — a tight deadline hedges
         sooner, no deadline uses the configured default.  Interactive
-        batches scale the result by ``interactive_hedge_factor``."""
+        batches scale the result by ``interactive_hedge_factor``.
+
+        ``ahead`` is how many dispatches the primary legitimately serves
+        before ours (its in-flight window, ISSUE 13): a depth-k replica
+        answers up to ``1 + ahead`` service times later WITHOUT being
+        silent, so the hedge clock stretches by that factor instead of
+        duplicating pipelined-but-healthy work — capped at 3/4 of any
+        remaining deadline so a genuinely wedged window still hedges
+        before the deadline burns."""
         if deadline is None:
             s = self.hedge_timeout
         else:
@@ -298,6 +315,11 @@ class ReplicaPool:
                 self.hedge_timeout,
                 max(self.min_hedge_timeout, remaining * 0.5),
             )
+        if ahead > 0:
+            s *= 1 + ahead
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                s = min(s, max(self.min_hedge_timeout, remaining * 0.75))
         if lane == "interactive":
             s = max(self.min_hedge_timeout, s * self.interactive_hedge_factor)
         return s
@@ -357,10 +379,15 @@ class ReplicaPool:
                 self.dispatched += 1
                 if lane in self.dispatched_by_lane:
                     self.dispatched_by_lane[lane] += 1
+            # captured BEFORE submit: dispatches legitimately served
+            # ahead of ours inside the primary's in-flight window
+            ahead = min(primary.load(), primary.depth() - 1)
             d = primary.submit(batch, deadline, model=model, lane=lane,
                                digests=digests)
             try:
-                out = d.future.result(timeout=self._hedge_s(deadline, lane))
+                out = d.future.result(
+                    timeout=self._hedge_s(deadline, lane, ahead=ahead)
+                )
                 self._done(t0)
                 return out
             except ReplicaDrained as e:
@@ -475,6 +502,11 @@ class ReplicaPool:
                 "no_healthy": self.no_healthy,
                 "dispatched_by_lane": dict(self.dispatched_by_lane),
             }
+        overlap = [r.overlap.snapshot() for r in self.replicas]
+        busy = [
+            o["device_busy_fraction"] for o in overlap
+            if o["device_busy_fraction"] is not None
+        ]
         out = {
             "replicas": per,
             "states": {r.index: r.state.value for r in self.replicas},
@@ -483,6 +515,20 @@ class ReplicaPool:
             "latency": {
                 "pool_service": self.service.snapshot(),
                 "replica_predict_merged": merged.snapshot(),
+            },
+            "overlap": {
+                "inflight_depth": max(r.depth() for r in self.replicas),
+                "inflight_hw": max(o["inflight_hw"] for o in overlap),
+                "fetches": sum(o["fetches"] for o in overlap),
+                "fetch_stall_ms": round(
+                    sum(o["fetch_stall_ms"] for o in overlap), 3
+                ),
+                "overlap_hidden_host_ms": round(
+                    sum(o["overlap_hidden_host_ms"] for o in overlap), 3
+                ),
+                "device_busy_fraction": (
+                    round(sum(busy) / len(busy), 4) if busy else None
+                ),
             },
             "compile": self.compile_cache.snapshot(),
         }

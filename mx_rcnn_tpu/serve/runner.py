@@ -31,6 +31,7 @@ import jax
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.core.resilience import host_copy
 from mx_rcnn_tpu.core.tester import Predictor, im_detect
 from mx_rcnn_tpu.data.image import (
     normalize,
@@ -234,6 +235,27 @@ def prepare_request(
 
 
 # ------------------------------------------------------------------ runner
+@dataclasses.dataclass
+class ServeHandle:
+    """Device-resident result of :meth:`ServeRunner.dispatch`.
+
+    ``outputs`` is the UN-FORCED output tree of the async jitted forward
+    (:meth:`Predictor.predict_async`): the device is still computing (or
+    has the result parked in device memory) when the handle is returned,
+    so the host is free to stage and dispatch the next batch.
+    :meth:`ServeRunner.complete` is the only sanctioned way to force it —
+    it fetches through the ``host_copy`` owning-copy discipline (a bare
+    ``device_get`` on CPU yields zero-copy views that a donating runner
+    mutates under the caller; graftlint R1 polices exactly this escape).
+    """
+
+    outputs: Dict
+    model: str
+    signature: Tuple
+    bucket: Tuple[int, int]
+    dispatch_t: float
+
+
 class _ModelSlot:
     """One model family's device-facing state on one runner: the jitted
     :class:`Predictor` bound to whatever version this runner last synced
@@ -356,6 +378,11 @@ class ServeRunner:
         self._staged: Dict[Tuple[str, int], object] = {}  # (model, ver) → tree
         self.served_buckets: Dict[str, set] = {}
         self.swaps_applied = 0
+        # split-path counters (ISSUE 13 overlap accounting; cumulative,
+        # read unlocked by snapshots like the staging counters above)
+        self.split_dispatches = 0
+        self.split_completes = 0
+        self.fetch_stall_s = 0.0  # wall time blocked in complete()'s fetch
         # build the default slot eagerly: construction fails fast on a
         # bad config, and legacy callers read .predictor immediately
         self._slot(self.default_model)
@@ -547,27 +574,60 @@ class ServeRunner:
                 pass
         return jax.device_put(batch)
 
+    def dispatch(
+        self,
+        batch: Dict[str, np.ndarray],
+        model: Optional[str] = None,
+    ) -> ServeHandle:
+        """First half of the predict path: sync the slot to the live
+        version, account the jit signature, stage the batch (layout-aware
+        H2D when ``layout_feed``), and fire the ASYNC jitted forward.
+        Returns a device-resident :class:`ServeHandle` without forcing
+        the outputs — the caller can keep staging/dispatching further
+        batches while the device computes, then :meth:`complete` this
+        one.  Adds no jit signatures beyond :meth:`run`'s: same bucket
+        pad, same ``max_batch``, same compiled program."""
+        mid = self.default_model if model is None else model
+        slot = self._slot(mid)
+        self._sync(slot)
+        sig = self._signature(batch, mid)
+        self.compile_cache.record(sig)
+        if self.layout_feed:
+            batch = self.stage(batch, mid)
+        bucket = tuple(batch["images"].shape[1:3])
+        outputs = slot.predictor.predict_async(batch)
+        self.served_buckets.setdefault(mid, set()).add(bucket)
+        self.split_dispatches += 1
+        return ServeHandle(
+            outputs=outputs, model=mid, signature=sig, bucket=bucket,
+            dispatch_t=time.monotonic(),
+        )
+
+    def complete(self, handle: ServeHandle) -> Dict[str, np.ndarray]:
+        """Second half: force the handle's device outputs to host memory
+        via the ``host_copy`` owning-copy discipline (blocks until the
+        device finishes).  Per-image postprocess stays downstream
+        (:meth:`detections_for` on the returned tree), unchanged from the
+        blocking path."""
+        t0 = time.monotonic()
+        out = host_copy(handle.outputs)
+        self.fetch_stall_s += time.monotonic() - t0
+        self.split_completes += 1
+        return out
+
     def run(
         self,
         batch: Dict[str, np.ndarray],
         model: Optional[str] = None,
     ) -> Dict[str, np.ndarray]:
         """Blocking forward through ``model``'s slot (default model when
-        None); syncs the slot to the registry's live version first and
-        accounts the (model, shape, dtype) jit signature.  Blocking by
-        design: the engine overlaps batches with threads, which the
-        relay-attached TPU actually pipelines (see ``pipelined``)."""
-        mid = self.default_model if model is None else model
-        slot = self._slot(mid)
-        self._sync(slot)
-        self.compile_cache.record(self._signature(batch, mid))
-        if self.layout_feed:
-            batch = self.stage(batch, mid)
-        out = slot.predictor.predict(batch)
-        self.served_buckets.setdefault(mid, set()).add(
-            tuple(batch["images"].shape[1:3])
-        )
-        return out
+        None): exactly :meth:`complete` ∘ :meth:`dispatch`, kept as the
+        composition so every pre-split caller and test is untouched.
+        The engine overlaps batches with threads, which the
+        relay-attached TPU actually pipelines (see ``pipelined``); the
+        replica pool overlaps through the split halves directly
+        (``Replica`` with ``inflight_depth > 1``)."""
+        return self.complete(self.dispatch(batch, model=model))
 
     def _probe_request(self, model_id: str, bucket: Tuple[int, int]) -> Request:
         bh, bw = bucket

@@ -129,6 +129,11 @@ def main():
                    "gated replicas (1 still exercises the pool path)")
     p.add_argument("--force_pool", action="store_true",
                    help="route through ReplicaPool even at --replicas 1")
+    p.add_argument("--inflight_depth", type=int, default=2,
+                   help="dispatches a replica keeps in flight (pool path): "
+                   "batch N+1 stages and computes while batch N's outputs "
+                   "fetch.  1 = the serial path, byte-identical results "
+                   "at any depth")
     p.add_argument("--max_batch", type=int, default=4)
     p.add_argument("--linger_ms", type=float, default=5.0)
     p.add_argument("--max_queue", type=int, default=64)
@@ -212,7 +217,8 @@ def main():
             ),
             registry=registry,
         )
-        runner = ReplicaPool(factory, n_replicas=args.replicas)
+        runner = ReplicaPool(factory, n_replicas=args.replicas,
+                             inflight_depth=args.inflight_depth)
     else:
         runner = ServeRunner(
             registry=registry, max_batch=args.max_batch, precision=precision

@@ -96,6 +96,43 @@ def test_r1_silent_on_consumed_and_copied():
     assert run_rule(R1_GOOD, HostCopyEscape()) == []
 
 
+# R1 against the ISSUE 13 split dispatch/complete shape: the completion
+# half is exactly where a bare device_get view would escape to a caller
+# that outlives the donated buffers
+
+R1_SPLIT_BAD = """
+import jax
+
+class Runner:
+    def dispatch(self, batch):
+        return self._fn(self.params, batch)
+
+    def complete(self, handle):
+        return jax.device_get(handle)
+"""
+
+R1_SPLIT_GOOD = """
+from mx_rcnn_tpu.core.resilience import host_copy
+
+class Runner:
+    def dispatch(self, batch):
+        return self._fn(self.params, batch)
+
+    def complete(self, handle):
+        return host_copy(handle)
+"""
+
+
+def test_r1_fires_on_split_complete_returning_view():
+    fs = run_rule(R1_SPLIT_BAD, HostCopyEscape())
+    assert len(fs) == 1 and fs[0].rule == "R1"
+    assert fs[0].scope == "Runner.complete"
+
+
+def test_r1_silent_on_split_complete_host_copy():
+    assert run_rule(R1_SPLIT_GOOD, HostCopyEscape()) == []
+
+
 # ---------------------------------------------------------------- R2
 
 R2_BAD = """
@@ -348,6 +385,51 @@ def test_r5_fires_on_droppable_take():
 
 def test_r5_silent_on_sentinel_and_drain():
     assert run_rule(R5_GOOD, ExactlyOnce(), path="mx_rcnn_tpu/serve/fx.py") == []
+
+
+# R5 against the ISSUE 13 overlapped window: the local ``pending`` deque
+# is a take source too — popping the oldest entry and then leaving the
+# scope without settling it drops a windowed dispatch
+
+R5_OVERLAP_BAD = """
+class Worker:
+    def loop(self):
+        pending = deque()
+        while True:
+            d = self._inbox.get(timeout=0.02)
+            if d is None:
+                break
+            pending.append(self._begin(d))
+            entry = pending.popleft()
+            if self._stop:
+                return
+            self._finish(entry)
+"""
+
+R5_OVERLAP_GOOD = """
+class Worker:
+    def loop(self):
+        pending = deque()
+        while not self._stop:
+            d = self._inbox.get(timeout=0.02)
+            if d is None:
+                break
+            pending.append(self._begin(d))
+            if pending:
+                entry = pending.popleft()
+                self._finish(entry)
+"""
+
+
+def test_r5_fires_on_droppable_window_entry():
+    fs = run_rule(R5_OVERLAP_BAD, ExactlyOnce(),
+                  path="mx_rcnn_tpu/serve/fx.py")
+    assert len(fs) == 1 and "`entry`" in fs[0].message
+
+
+def test_r5_silent_on_settled_window_entry():
+    assert run_rule(R5_OVERLAP_GOOD, ExactlyOnce(),
+                    path="mx_rcnn_tpu/serve/fx.py") == []
 
 
 # ---------------------------------------------------------------- R6
@@ -854,3 +936,43 @@ def test_poison_artifact_schema_guard(tmp_path):
     assert "'all_replicas_healthy' missing" in errs
     assert "digests empty" in errs
     assert "no record metric 'serve_poison_healthy_lost*'" in errs
+
+
+def test_overlap_artifact_schema_guard(tmp_path):
+    """BENCH_serve_overlap_cpu.json must carry the four ISSUE 13
+    acceptance claims — all true — plus per-depth device-busy fractions
+    and the speedup/identity/fault metric records."""
+    claims = {
+        "speedup_ge_1_3": True,
+        "byte_identical": True,
+        "zero_lost_under_faults": True,
+        "zero_steady_state_recompiles": True,
+    }
+    good = {
+        "records": [
+            {"metric": m, "value": 1}
+            for m in ("serve_overlap_speedup",
+                      "serve_overlap_byte_identical",
+                      "serve_overlap_fault_lost",
+                      "serve_overlap_steady_state_compile_misses")
+        ],
+        "report": {
+            "claims": dict(claims),
+            "depth1": {"device_busy_fraction": 0.6},
+            "depth2": {"device_busy_fraction": 0.95},
+        },
+    }
+    art = tmp_path / "BENCH_serve_overlap_cpu.json"
+    art.write_text(json.dumps(good))
+    assert check_bench_artifacts(tmp_path) == []
+
+    good["report"]["claims"]["speedup_ge_1_3"] = False
+    del good["report"]["claims"]["byte_identical"]
+    del good["report"]["depth2"]["device_busy_fraction"]
+    good["records"] = good["records"][1:]
+    art.write_text(json.dumps(good))
+    errs = " | ".join(check_bench_artifacts(tmp_path))
+    assert "'speedup_ge_1_3' not true" in errs
+    assert "'byte_identical' missing" in errs
+    assert "depth2.device_busy_fraction missing" in errs
+    assert "no record metric 'serve_overlap_speedup*'" in errs
