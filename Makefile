@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault swap slo poison pipeline elastic chaos integration-gate clean-native
+.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault serve-mask swap slo poison pipeline elastic chaos integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -88,6 +88,18 @@ serve:
 serve-overlap:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve_overlap \
 	      --out BENCH_serve_overlap_cpu.json
+
+# mask-family serving bench (ISSUE 14): device-side mask selection —
+# the jit gathers each survivor's S×S grid for its predicted class, so
+# the host fetches [max_det, S, S] instead of the raw (R, S, S, K)
+# stack.  Emits fetch bytes/batch raw vs device (the >=5x claim),
+# per-detection RLE byte-identity vs the host path across all buckets,
+# p50/p99 under mixed-size load, and the zero-steady-state-recompile
+# count, as JSON lines + the BENCH_serve_mask_cpu.json artifact
+serve-mask:
+	JAX_PLATFORMS=cpu $(PY) bench.py --serve_mask --serve_requests 24 \
+	      --serve_concurrency 6 --serve_max_batch 4 \
+	      --out BENCH_serve_mask_cpu.json
 
 # fault-matrix serving bench (ISSUE 6): the same deterministic load
 # against a 3-replica health-gated pool under healthy / wedged-replica /

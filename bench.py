@@ -244,6 +244,260 @@ def bench_serve(
     return records, report
 
 
+def _mask_serve_cfg():
+    """Small mask-family serving config sized so the fetch ratio is a
+    statement about the PATH, not the padding: 64 post-NMS rois keep the
+    raw ``(B, R, S, S, K)`` mask stack the dominant fetch term (~3.2 MB
+    per b=4 batch at S=28, K=4) while the device path ships only the 16
+    capped survivors' grids (~0.2 MB).  The flagship config's ratio is
+    larger still (R=300, K=21 → ~50×); this is the CPU-runnable
+    miniature of the same geometry."""
+    from mx_rcnn_tpu.tools.serve import small_config
+
+    cfg = small_config("mask_resnet_fpn")
+    return cfg.replace(
+        TEST=dataclasses.replace(
+            cfg.TEST,
+            RPN_POST_NMS_TOP_N=64,
+            DET_PER_CLASS=16,
+            MAX_PER_IMAGE=16,
+        ),
+    )
+
+
+def _rles_for_image(runner, out, batch, h, w, model=None):
+    """One image's outputs → (cls_dets, {cls: [rle, ...]}) through the
+    canonical decode + cap + paste + RLE chain (eval/segm.py)."""
+    from mx_rcnn_tpu.eval.segm import rles_for_detections
+
+    cls_dets, mask_probs = runner.detections_for(
+        out, batch, 0, orig_hw=(h, w), model=model, with_masks=True
+    )
+    rles = {
+        j: rles_for_detections(mask_probs[j], cls_dets[j], h, w)
+        for j in range(1, len(cls_dets))
+    }
+    return cls_dets, rles
+
+
+def _rles_equal(a: dict, b: dict) -> bool:
+    if set(a) != set(b):
+        return False
+    for j in a:
+        if len(a[j]) != len(b[j]):
+            return False
+        for ra, rb in zip(a[j], b[j]):
+            if ra["size"] != rb["size"] or ra["counts"] != rb["counts"]:
+                return False
+    return True
+
+
+def bench_serve_mask(
+    requests: int,
+    concurrency: int,
+    max_batch: int,
+    linger_ms: float,
+    replicas: int = 1,
+    inflight_depth: int = 2,
+) -> tuple:
+    """Mask-family serving bench (ISSUE 14): device-side mask selection
+    vs the raw-head path.
+
+    Two phases on one model + params:
+
+    1. **parity + fetch accounting** — every ladder bucket (and an
+       odd-size request per bucket, exercising the padding config) runs
+       through BOTH a device-postprocess runner and a raw-head runner
+       (``device_postprocess=False``), both ``deterministic=True``; the
+       final per-detection RLEs must be byte-identical and the
+       ``fetch_bytes`` counters give the measured per-complete reduction.
+    2. **pool + engine load** — the mask family registered as a NAMED
+       registry entry ("masks") served through the ReplicaPool and the
+       real engine intake by the synthetic load generator; p50/p99,
+       per-model pool fetch bytes, and the zero-steady-state-recompile
+       invariant (misses == ladder rungs) come from this phase.
+    """
+    import jax
+
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.loadgen import run_load, synthetic_image
+    from mx_rcnn_tpu.serve.registry import ModelRegistry
+    from mx_rcnn_tpu.serve.router import ReplicaPool, make_replica_factory
+    from mx_rcnn_tpu.serve.runner import ServeRunner
+
+    cfg = _mask_serve_cfg()
+    sizes = ((72, 96), (96, 128), (64, 80), (128, 128))
+    model = build_model(cfg)
+    h0, w0 = cfg.SHAPE_BUCKETS[0]
+    params = model.init(
+        {"params": jax.random.key(0)},
+        np.zeros((1, h0, w0, 3), np.float32),
+        np.array([[h0, w0, 1.0]], np.float32),
+        train=False,
+    )["params"]
+
+    # Random init saturates the softmax — every roi scores EXACTLY 1.0
+    # for one class, so host-vs-device keep order on those exact float
+    # ties is undefined and the parity phase would measure tie-break
+    # luck, not the path.  Damp the score/delta heads so every roi
+    # carries a distinct non-saturated score and decoded boxes stay off
+    # the clip rails; the mask head too, which also keeps the reference
+    # sigmoid out of float overflow.  The compiled programs are
+    # unchanged — only the weights are.
+    def _damp(path, leaf):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        for frag in ("rpn_cls_score", "rpn_bbox_pred", "cls_score",
+                     "bbox_pred", "mask_logits"):
+            if frag in name:
+                return leaf * 1e-2
+        return leaf
+
+    params = jax.tree_util.tree_map_with_path(_damp, params)
+
+    registry = ModelRegistry()
+    registry.register("masks", model, cfg, params)
+    factory = make_replica_factory(
+        lambda registry, device: ServeRunner(
+            registry=registry, device=device, max_batch=max_batch,
+            deterministic=True,
+        ),
+        registry=registry,
+    )
+    pool = ReplicaPool(factory, n_replicas=replicas,
+                       inflight_depth=inflight_depth)
+    rungs = pool.warmup()
+
+    # raw-head reference runner: same model/params/cfg, postprocess OFF —
+    # the pre-ISSUE-14 mask serving path, fetching the full head outputs
+    raw = ServeRunner(
+        model, params, cfg, max_batch=max_batch, deterministic=True,
+        device_postprocess=False,
+    )
+    raw.warmup()
+
+    dev_runner = pool.replicas[0].runner
+    dev_base = (dev_runner.fetch_bytes_total, dev_runner.split_completes)
+    raw_base = (raw.fetch_bytes_total, raw.split_completes)
+    byte_identical = True
+    parity = []
+    for i, (ih, iw) in enumerate(sizes):
+        im = synthetic_image(i, ih, iw, seed=0)
+        dreq = dev_runner.make_request(im, model="masks")
+        rreq = raw.make_request(im)
+        dout = dev_runner.run(dev_runner.assemble([dreq]), model="masks")
+        rout = raw.run(raw.assemble([rreq]))
+        d_dets, d_rles = _rles_for_image(
+            dev_runner, dout, {"im_info": [dreq.im_info]}, ih, iw,
+            model="masks",
+        )
+        r_dets, r_rles = _rles_for_image(
+            raw, rout, {"im_info": [rreq.im_info]}, ih, iw
+        )
+        # scores must be bitwise equal (pure gather on device); box
+        # coords carry the known XLA-vs-numpy decode ulp (~4e-6 px), so
+        # they get a tight tolerance, NOT equality — the RLE check
+        # downstream is the strict byte-level bar
+        scores_eq, box_delta, count_eq = True, 0.0, True
+        for a, b in zip(d_dets[1:], r_dets[1:]):
+            if (a is None) != (b is None) or \
+                    (a is not None and len(a) != len(b)):
+                count_eq = False
+                continue
+            if a is None or len(a) == 0:
+                continue
+            scores_eq &= a[:, 4].tobytes() == b[:, 4].tobytes()
+            box_delta = max(
+                box_delta, float(np.abs(a[:, :4] - b[:, :4]).max())
+            )
+        dets_eq = count_eq and scores_eq and box_delta <= 1e-4
+        rles_eq = _rles_equal(d_rles, r_rles)
+        byte_identical &= dets_eq and rles_eq
+        parity.append({
+            "size": [ih, iw], "bucket": list(dreq.bucket),
+            "detections": int(sum(
+                len(d) for d in d_dets[1:] if d is not None
+            )),
+            "scores_byte_identical": scores_eq,
+            "max_box_delta": box_delta,
+            "rles_byte_identical": rles_eq,
+        })
+    dev_bytes = dev_runner.fetch_bytes_total - dev_base[0]
+    dev_completes = dev_runner.split_completes - dev_base[1]
+    raw_bytes = raw.fetch_bytes_total - raw_base[0]
+    raw_completes = raw.split_completes - raw_base[1]
+    dev_per_batch = dev_bytes / max(dev_completes, 1)
+    raw_per_batch = raw_bytes / max(raw_completes, 1)
+    reduction = raw_per_batch / max(dev_per_batch, 1)
+
+    with ServingEngine(pool, max_linger=linger_ms / 1000.0) as engine:
+        load = run_load(
+            engine, num_requests=requests, concurrency=concurrency,
+            sizes=sizes[:3], seed=0, models=["masks"],
+        )
+    snap = pool.snapshot()
+    pool.close()
+    eng = load["engine"]
+    steady_misses = snap["compile"]["misses"] - rungs
+    claims = {
+        "fetch_reduction_ge_5x": bool(reduction >= 5.0),
+        "rle_byte_identical": bool(byte_identical),
+        "zero_steady_state_recompiles": bool(steady_misses == 0),
+    }
+    report = {
+        "claims": claims,
+        "fetch_bytes": {
+            "raw_per_batch": round(raw_per_batch, 1),
+            "device_per_batch": round(dev_per_batch, 1),
+            "reduction": round(reduction, 2),
+            "pool_fetch_bytes": snap["overlap"]["fetch_bytes"],
+            "pool_fetch_bytes_by_model":
+                snap["overlap"]["fetch_bytes_by_model"],
+        },
+        "parity": parity,
+        "config": {
+            "rpn_post_nms_top_n": cfg.TEST.RPN_POST_NMS_TOP_N,
+            "det_per_class": cfg.TEST.DET_PER_CLASS,
+            "max_per_image": cfg.TEST.MAX_PER_IMAGE,
+            "mask_size": cfg.TRAIN.MASK_SIZE,
+            "num_classes": cfg.dataset.NUM_CLASSES,
+            "ladder_rungs": rungs,
+        },
+        "engine": eng,
+        "load": {
+            "imgs_per_sec": load["imgs_per_sec"],
+            "requests": requests,
+        },
+    }
+    records = [
+        {"metric": "serve_mask_p50_ms",
+         "value": eng["latency"]["e2e"]["p50_ms"],
+         "unit": "ms", "vs_baseline": None},
+        {"metric": "serve_mask_p99_ms",
+         "value": eng["latency"]["e2e"]["p99_ms"],
+         "unit": "ms", "vs_baseline": None},
+        {"metric": "serve_mask_imgs_per_sec",
+         "value": load["imgs_per_sec"],
+         "unit": "imgs/sec", "vs_baseline": None},
+        {"metric": "serve_mask_fetch_bytes_per_batch_raw",
+         "value": round(raw_per_batch, 1),
+         "unit": "bytes", "vs_baseline": None},
+        {"metric": "serve_mask_fetch_bytes_per_batch_device",
+         "value": round(dev_per_batch, 1),
+         "unit": "bytes", "vs_baseline": None},
+        {"metric": "serve_mask_fetch_reduction",
+         "value": round(reduction, 2),
+         "unit": "x", "vs_baseline": None},
+        {"metric": "serve_mask_rle_byte_identical",
+         "value": 1.0 if byte_identical else 0.0,
+         "unit": "bool", "vs_baseline": None},
+        {"metric": "serve_mask_steady_state_compile_misses",
+         "value": steady_misses,
+         "unit": "compiles", "vs_baseline": None},
+    ]
+    return records, report
+
+
 def _pctl_ms(lats_ms: list, p: float) -> float:
     """Exact percentile over a small latency sample (sorted interp)."""
     if not lats_ms:
@@ -2098,6 +2352,14 @@ def main():
                     help="stub D2H fetch + host postprocess per batch "
                          "for --serve_overlap")
     ap.add_argument(
+        "--serve_mask", action="store_true",
+        help="mask-family serving bench (ISSUE 14): device-side mask "
+             "selection vs the raw-head path — per-batch fetch bytes "
+             "before/after, RLE byte-identity across every bucket and "
+             "padding config, p50/p99 through the replica pool, and "
+             "zero steady-state recompiles",
+    )
+    ap.add_argument(
         "--serve_fault", action="store_true",
         help="fault-matrix serving bench: healthy vs wedged vs flapping "
              "replica scenarios on a >=3-replica pool (zero-lost + "
@@ -2280,6 +2542,20 @@ def main():
             concurrency=args.serve_concurrency // 2 or 8,
             device_ms=args.overlap_device_ms,
             fetch_ms=args.overlap_fetch_ms,
+        )
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
+
+    if args.serve_mask:
+        records, report = bench_serve_mask(
+            args.serve_requests, args.serve_concurrency,
+            args.serve_max_batch, args.serve_linger_ms,
+            replicas=args.serve_replicas,
+            inflight_depth=args.inflight_depth,
         )
         for rec in records:
             print(json.dumps(rec), flush=True)

@@ -196,6 +196,62 @@ def _check_overlap_schema(name: str, doc: dict) -> List[str]:
     return errors
 
 
+# mask-family serving bench (ISSUE 14): the device-side mask selection
+# artifact must carry the three closure claims — the >=5x fetch-byte
+# reduction, per-detection RLE byte-identity vs the host path, and zero
+# steady-state recompiles — plus the measured fetch-byte evidence the
+# reduction claim rests on.
+_MASK_CLAIMS = (
+    "fetch_reduction_ge_5x",
+    "rle_byte_identical",
+    "zero_steady_state_recompiles",
+)
+
+_MASK_METRIC_PREFIXES = (
+    "serve_mask_p50_ms",
+    "serve_mask_p99_ms",
+    "serve_mask_fetch_bytes_per_batch_raw",
+    "serve_mask_fetch_bytes_per_batch_device",
+    "serve_mask_fetch_reduction",
+    "serve_mask_rle_byte_identical",
+    "serve_mask_steady_state_compile_misses",
+)
+
+
+def _check_mask_schema(name: str, doc: dict) -> List[str]:
+    errors = []
+    report = doc.get("report") if isinstance(doc, dict) else None
+    if not isinstance(report, dict):
+        return [f"bench artifact {name}: missing report object"]
+    claims = report.get("claims")
+    if not isinstance(claims, dict):
+        return [f"bench artifact {name}: report.claims missing"]
+    for c in _MASK_CLAIMS:
+        if c not in claims:
+            errors.append(f"bench artifact {name}: claim '{c}' missing")
+        elif claims[c] is not True:
+            errors.append(f"bench artifact {name}: claim '{c}' not true")
+    fb = report.get("fetch_bytes")
+    if not isinstance(fb, dict) or not {
+        "raw_per_batch", "device_per_batch", "reduction"
+    } <= set(fb):
+        errors.append(
+            f"bench artifact {name}: report.fetch_bytes incomplete — the "
+            f"fetch-reduction claim has no measured byte evidence"
+        )
+    metrics = {
+        r.get("metric", "")
+        for r in doc.get("records", [])
+        if isinstance(r, dict)
+    }
+    for prefix in _MASK_METRIC_PREFIXES:
+        if not any(m.startswith(prefix) for m in metrics):
+            errors.append(
+                f"bench artifact {name}: no record metric '{prefix}*'"
+            )
+    return errors
+
+
 def check_bench_artifacts(root: Path) -> List[str]:
     errors = []
     for f in sorted(root.glob("BENCH_*.json")):
@@ -215,6 +271,8 @@ def check_bench_artifacts(root: Path) -> List[str]:
             errors += _check_poison_schema(f.name, doc)
         if f.name == "BENCH_serve_overlap_cpu.json":
             errors += _check_overlap_schema(f.name, doc)
+        if f.name == "BENCH_serve_mask_cpu.json":
+            errors += _check_mask_schema(f.name, doc)
     return errors
 
 

@@ -98,8 +98,10 @@ class TestConfig:
     DET_PER_CLASS: int = 100
     # device-side eval postprocess (ops/postprocess.py): per-class
     # decode+NMS runs in the forward jit and only keep lists cross the
-    # relay; False restores the reference-style host loop (always used
-    # for mask models — masks need the full logits on host anyway)
+    # relay; for mask models the jit also gathers each survivor's S×S
+    # mask-logit grid for its predicted class (det_masks), so only
+    # selected grids cross — sigmoid/paste/RLE stay host-side.  False
+    # restores the reference-style host loop
     DEVICE_POSTPROCESS: bool = True
     # ship eval images as uint8 and normalize on device — 4× less H2D
     # traffic for a ≤0.5-LSB quantization of the resized pixels

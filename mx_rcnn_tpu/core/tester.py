@@ -35,7 +35,10 @@ class Predictor:
     ``postprocess`` (ops/postprocess.py): fuses per-class decode+NMS
     into the same jit, so only keep lists cross the device→host link
     instead of the full (B, R, K)+(B, R, 4K) head outputs.  Mask models
-    skip it automatically (mask pasting needs full outputs on host)."""
+    get the same treatment: the postprocess gathers each survivor's
+    class-channel S×S grid on device (``det_masks``), so the raw
+    ``(B, R, S, S, K)`` stack never crosses the link — host workers
+    only sigmoid + paste + RLE-encode."""
 
     def __init__(self, model, params, postprocess=None, donate: bool = False,
                  deterministic: bool = False):
@@ -49,11 +52,7 @@ class Predictor:
             batch = dict(batch)
             orig_hw = batch.pop("orig_hw", None)
             out = model.apply({"params": p}, train=False, **batch)
-            if (
-                postprocess is not None
-                and orig_hw is not None
-                and "mask_logits" not in out
-            ):
+            if postprocess is not None and orig_hw is not None:
                 return postprocess(out, batch["im_info"], orig_hw)
             return out
 

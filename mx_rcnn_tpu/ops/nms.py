@@ -101,6 +101,7 @@ def nms(
     max_out: int,
     valid: jnp.ndarray | None = None,
     sorted_input: bool = False,
+    with_idx: bool = False,
 ):
     """NMS + select top ``max_out`` survivors by score (fixed shape).
 
@@ -109,6 +110,13 @@ def nms(
     This is the in-graph replacement for the keep-list interface of
     ``gpu_nms`` — the pad-to-``post_nms_top_n`` discipline the reference
     already applied in ``rcnn/symbol/proposal.py`` generalized.
+
+    ``with_idx`` appends the top-k source indices ``idx (max_out,)`` —
+    each survivor's position in the INPUT order, which downstream gathers
+    (device mask selection) use to index back into per-roi head outputs.
+    ``idx`` is only meaningful where ``valid``; when ``N < max_out`` the
+    scores are padded before ``top_k``, so invalid slots may carry
+    indices ≥ N — callers must clamp or mask before gathering.
     """
     # with a sorted input the kernel may stop once max_out survivors
     # exist — the top_k below only ever reads that prefix
@@ -124,6 +132,8 @@ def nms(
     top_scores, idx = jax.lax.top_k(masked, max_out)
     out_valid = top_scores > _NEG_INF / 2
     out_boxes = jnp.where(out_valid[:, None], boxes[idx], 0.0)
+    if with_idx:
+        return out_boxes, top_scores, out_valid, idx
     return out_boxes, top_scores, out_valid
 
 
@@ -133,18 +143,21 @@ def batched_class_nms(
     thresh: float,
     max_out: int,
     valid: jnp.ndarray | None = None,
+    with_idx: bool = False,
 ):
     """Per-class NMS, vmapped over a leading class axis.
 
     ``boxes`` (C, N, 4), ``scores`` (C, N) → (C, max_out, ·) padded.
     Replaces the per-class python loop in
     ``rcnn/core/tester.py :: pred_eval`` with one in-graph batched op.
+    ``with_idx`` threads the per-class survivor source indices through
+    (see :func:`nms`) for device-side mask gathering.
     """
     if valid is None:
         valid = jnp.ones(scores.shape, dtype=bool)
-    return jax.vmap(lambda b, s, v: nms(b, s, thresh, max_out, v))(
-        boxes, scores, valid
-    )
+    return jax.vmap(
+        lambda b, s, v: nms(b, s, thresh, max_out, v, with_idx=with_idx)
+    )(boxes, scores, valid)
 
 
 def nms_numpy(dets: np.ndarray, thresh: float) -> list:

@@ -26,10 +26,13 @@ from __future__ import annotations
 from typing import Dict
 
 import jax
+import jax.numpy as jnp
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
 from mx_rcnn_tpu.ops.nms import batched_class_nms
+
+_NEG_INF = -1e10
 
 
 def make_test_postprocess(
@@ -39,8 +42,27 @@ def make_test_postprocess(
     det_valid}`` with shapes (B, K−1, max_out, ·); class j's detections
     live at row j−1 (background has none).  Boxes are in ORIGINAL image
     coordinates (``orig_hw`` (B, 2) = pre-resize heights/widths, shipped
-    by TestLoader)."""
+    by TestLoader).
+
+    Mask models: when ``out`` carries ``mask_logits`` (B, R, S, S, K),
+    the same program additionally gathers — still on device — each
+    surviving detection's S×S grid for its predicted class, for the
+    cross-class top ``max_det = TEST.MAX_PER_IMAGE`` survivors by score
+    (the per-image cap the host applies anyway in ``cap_detections``).
+    Three fixed-shape outputs ride along: ``det_masks`` (B, max_det,
+    S, S) float32 LOGITS (sigmoid stays host so the bits match the
+    reference ``im_detect`` numpy expression exactly), ``det_mask_idx``
+    (B, max_det) int32 flat index ``(class_row)*max_out + slot`` into
+    the det grid (−1 on padding), and ``det_mask_valid`` (B, max_det).
+    Only these come over the wire — the raw ``(R, S, S, K)`` stack never
+    leaves the device.  ``max_det`` is static, so the CompileCache
+    bucket ladder stays zero-recompile."""
     te = cfg.TEST
+    max_det = te.MAX_PER_IMAGE if te.MAX_PER_IMAGE > 0 \
+        else (num_classes - 1) * max_out
+    # the det grid only holds (K-1)*max_out candidates — a larger cap
+    # would make top_k's k exceed its operand
+    max_det = min(max_det, (num_classes - 1) * max_out)
 
     def one_image(rois, valid, scores, deltas, info, ohw):
         r, k = scores.shape
@@ -52,10 +74,37 @@ def make_test_postprocess(
         boxes_k = boxes.reshape(r, k, 4).transpose(1, 0, 2)[1:]   # (K-1, R, 4)
         scores_k = scores.T[1:]                                   # (K-1, R)
         valid_k = valid[None, :] & (scores_k > thresh)
-        return batched_class_nms(boxes_k, scores_k, te.NMS, max_out, valid_k)
+        return batched_class_nms(
+            boxes_k, scores_k, te.NMS, max_out, valid_k, with_idx=True
+        )
+
+    def one_image_masks(ob, os_, ov, oi, mask_logits):
+        # (K-1, max_out) det grid → flat cross-class top-max_det by
+        # score; ties break toward the lower flat index (top_k), which
+        # only diverges from the host cap on exact float score ties.
+        r = mask_logits.shape[0]
+        flat_scores = jnp.where(ov, os_, _NEG_INF).reshape(-1)
+        top_s, top_flat = jax.lax.top_k(flat_scores, max_det)
+        mvalid = top_s > _NEG_INF / 2
+        # survivor's source roi (per-class nms idx may exceed R on
+        # padding slots — clamp before the gather) and class channel
+        roi_idx = jnp.clip(oi.reshape(-1)[top_flat], 0, r - 1)
+        roi_idx = jnp.where(mvalid, roi_idx, 0)
+        cls = jnp.where(mvalid, top_flat // ov.shape[1] + 1, 1)
+        grids = jax.vmap(lambda ri, c: mask_logits[ri, :, :, c])(
+            roi_idx, cls
+        )
+        # large-negative logits on padding rows: padding-count invariant
+        # AND safe if one ever leaks to paste (sigmoid ≈ 0, empty mask,
+        # no exp overflow on host)
+        grids = jnp.where(
+            mvalid[:, None, None], grids, jnp.float32(-80.0)
+        ).astype(jnp.float32)
+        midx = jnp.where(mvalid, top_flat, -1).astype(jnp.int32)
+        return grids, midx, mvalid
 
     def batched(out: Dict, im_info, orig_hw):
-        ob, os_, ov = jax.vmap(one_image)(
+        ob, os_, ov, oi = jax.vmap(one_image)(
             out["rois"],
             out["roi_valid"].astype(bool),
             out["cls_prob"],
@@ -63,6 +112,14 @@ def make_test_postprocess(
             im_info,
             orig_hw,
         )
-        return {"det_boxes": ob, "det_scores": os_, "det_valid": ov}
+        res = {"det_boxes": ob, "det_scores": os_, "det_valid": ov}
+        if "mask_logits" in out:
+            grids, midx, mvalid = jax.vmap(one_image_masks)(
+                ob, os_, ov, oi, out["mask_logits"]
+            )
+            res["det_masks"] = grids
+            res["det_mask_idx"] = midx
+            res["det_mask_valid"] = mvalid
+        return res
 
     return batched

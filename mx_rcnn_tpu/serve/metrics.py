@@ -123,6 +123,11 @@ class OverlapStats:
       approaches 1.  (The device may still be computing the batch being
       fetched, so this is a conservative lower bound, not a device-side
       trace.)
+    * ``fetch_bytes`` / ``fetch_bytes_by_model`` (ISSUE 14) — total
+      bytes the ``complete()`` host copies actually moved, per model.
+      This is the measured counter behind the device-postprocess fetch
+      reduction (mask families: selected ``det_masks`` grids instead of
+      the raw ``(R, S, S, K)`` stack).
 
     All methods are O(1) and lock-protected; ``note_depth`` is called at
     every window size change, ``note_fetch`` once per ``complete()``.
@@ -135,6 +140,8 @@ class OverlapStats:
         self.fetch_stall_s = 0.0
         self.hidden_host_s = 0.0
         self.idle_fetch_s = 0.0   # fetch time with an otherwise-empty window
+        self.fetch_bytes = 0
+        self.fetch_bytes_by_model: Dict[str, int] = {}
         self._t0: Optional[float] = None   # first dispatch ever
         self._t_last: Optional[float] = None
 
@@ -148,7 +155,13 @@ class OverlapStats:
             if depth > self.inflight_hw:
                 self.inflight_hw = depth
 
-    def note_fetch(self, seconds: float, hidden: bool) -> None:
+    def note_fetch(
+        self,
+        seconds: float,
+        hidden: bool,
+        nbytes: int = 0,
+        model: Optional[str] = None,
+    ) -> None:
         s = max(float(seconds), 0.0)
         with self._lock:
             self.fetches += 1
@@ -157,6 +170,12 @@ class OverlapStats:
                 self.hidden_host_s += s
             else:
                 self.idle_fetch_s += s
+            if nbytes:
+                self.fetch_bytes += int(nbytes)
+                key = model if model is not None else "default"
+                self.fetch_bytes_by_model[key] = (
+                    self.fetch_bytes_by_model.get(key, 0) + int(nbytes)
+                )
 
     def note_hidden(self, seconds: float) -> None:
         with self._lock:
@@ -179,6 +198,8 @@ class OverlapStats:
                 "fetch_stall_ms": round(self.fetch_stall_s * 1e3, 3),
                 "overlap_hidden_host_ms": round(self.hidden_host_s * 1e3, 3),
                 "device_busy_fraction": busy,
+                "fetch_bytes": self.fetch_bytes,
+                "fetch_bytes_by_model": dict(self.fetch_bytes_by_model),
             }
 
 

@@ -506,7 +506,13 @@ class Replica:
             hidden = len(self._inflight) > 1  # a sibling covers this fetch
             t_f = time.monotonic()
             out = self.runner.complete(d.handle)
-            self.overlap.note_fetch(time.monotonic() - t_f, hidden=hidden)
+            self.overlap.note_fetch(
+                time.monotonic() - t_f, hidden=hidden,
+                # complete() just ran on THIS thread, so the runner's
+                # last-fetch size is this dispatch's host copy
+                nbytes=getattr(self.runner, "last_fetch_bytes", 0),
+                model=getattr(d.handle, "model", None),
+            )
         except Exception as first:  # noqa: BLE001 — in-place retry tail
             try:
                 out = self._retry_tail(d, first)

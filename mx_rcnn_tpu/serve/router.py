@@ -64,6 +64,15 @@ from mx_rcnn_tpu.serve.replica import (
 )
 
 
+def _merge_byte_counts(dicts) -> Dict[str, int]:
+    """Sum per-model byte counters across replica snapshots."""
+    merged: Dict[str, int] = {}
+    for d in dicts:
+        for k, v in d.items():
+            merged[k] = merged.get(k, 0) + int(v)
+    return merged
+
+
 class NoHealthyReplica(RuntimeError):
     """Every replica is draining/recovering — the pool has zero capacity
     (the engine surfaces this as a failed batch; intake shedding should
@@ -528,6 +537,10 @@ class ReplicaPool:
                 ),
                 "device_busy_fraction": (
                     round(sum(busy) / len(busy), 4) if busy else None
+                ),
+                "fetch_bytes": sum(o.get("fetch_bytes", 0) for o in overlap),
+                "fetch_bytes_by_model": _merge_byte_counts(
+                    o.get("fetch_bytes_by_model", {}) for o in overlap
                 ),
             },
             "compile": self.compile_cache.snapshot(),

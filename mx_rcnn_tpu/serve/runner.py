@@ -125,6 +125,47 @@ def detection_parity(
     }
 
 
+def mask_parity(
+    ref_dets: ClsDets,
+    ref_masks: Dict[int, np.ndarray],
+    test_dets: ClsDets,
+    test_masks: Dict[int, np.ndarray],
+    thresh: float,
+    margin: float = 0.1,
+    match_iou: float = 0.5,
+) -> Dict:
+    """Mask-grid parity companion to :func:`detection_parity`: for every
+    confident reference detection with an IoU-matched counterpart, the
+    max absolute per-pixel probability delta between the two S×S grids.
+    This is what lets the bf16 gate cover mask models — without it a
+    reduced-precision graph could pass on boxes while shipping drifted
+    masks."""
+    max_delta = 0.0
+    pairs = 0
+    for j in range(1, len(ref_dets)):
+        a = ref_dets[j]
+        b = test_dets[j] if j < len(test_dets) else None
+        ma = ref_masks.get(j) if ref_masks else None
+        mb = test_masks.get(j) if test_masks else None
+        if a is None or b is None or ma is None or mb is None \
+                or not len(a) or not len(b):
+            continue
+        conf = np.where(np.asarray(a)[:, 4] >= thresh + margin)[0]
+        if not len(conf):
+            continue
+        iou = _box_iou(np.asarray(a)[conf, :4], np.asarray(b)[:, :4])
+        best = iou.argmax(axis=1)
+        for t, i in enumerate(conf):
+            k = int(best[t])
+            if iou[t, k] < match_iou:
+                continue
+            pairs += 1
+            max_delta = max(
+                max_delta, float(np.abs(ma[i] - mb[k]).max())
+            )
+    return {"max_mask_prob_delta": round(max_delta, 5), "mask_pairs": pairs}
+
+
 # --------------------------------------------------------------- detections
 def detections_from_output(
     out: Dict[str, np.ndarray],
@@ -143,19 +184,45 @@ def detections_from_output(
     (host decode via :func:`~mx_rcnn_tpu.core.tester.im_detect`, then
     per-class threshold + native NMS, the reference ``pred_eval`` inner
     loop).  Returns ``(cls_dets, mask_probs)``; ``cls_dets[0]`` is None
-    (background), ``mask_probs`` is None unless the model emitted
-    ``mask_logits`` (host path only — mask models skip device postprocess).
+    (background), ``mask_probs`` is None unless the model is a mask
+    family.  On the device path a mask model ships already-selected
+    per-survivor grids (``det_masks`` LOGITS + ``det_mask_idx`` flat
+    det-grid indices, ops/postprocess.py) — the sigmoid happens here,
+    with the exact numpy expression of the reference ``im_detect``, so
+    the resulting probabilities are bit-identical to the raw-head path.
     """
     te = cfg.TEST
     thresh = te.SCORE_THRESH if thresh is None else thresh
     cls_dets: ClsDets = [None] * num_classes
     mask_probs: Optional[Dict[int, np.ndarray]] = None
     if "det_boxes" in out:
+        lut = None
+        if "det_masks" in out:
+            mask_probs = {}
+            midx = np.asarray(out["det_mask_idx"][index])
+            grids = np.asarray(out["det_masks"][index])
+            lut = {int(f): p for p, f in enumerate(midx) if f >= 0}
+        max_out = out["det_boxes"].shape[2]
         for j in range(1, num_classes):
             m = np.asarray(out["det_valid"][index][j - 1]).astype(bool)
             b = np.asarray(out["det_boxes"][index][j - 1][m])
             s = np.asarray(out["det_scores"][index][j - 1][m])
             cls_dets[j] = np.hstack([b, s[:, None]]).astype(np.float32)
+            if lut is not None:
+                rows = np.where(m)[0]
+                # rows beyond the device's max_det mask budget only
+                # exist past the MAX_PER_IMAGE cut — cap_detections
+                # drops them; the large-negative logit fill (sigmoid ≈ 0
+                # → empty mask, no exp overflow) keeps any
+                # exact-score-tie leak safe, not wrong
+                g = np.full(
+                    (len(rows),) + grids.shape[1:], -80.0, np.float32
+                )
+                for t, rr in enumerate(rows):
+                    p = lut.get((j - 1) * max_out + int(rr))
+                    if p is not None:
+                        g[t] = grids[p]
+                mask_probs[j] = 1.0 / (1.0 + np.exp(-g))
     else:
         det = im_detect(out, im_info_row, orig_hw, index=index)
         scores, boxes = det["scores"], det["boxes"]
@@ -321,6 +388,7 @@ class ServeRunner:
         parity_box_tol: float = 4.0,
         parity_score_tol: float = 0.1,
         parity_margin: float = 0.1,
+        parity_mask_tol: float = 0.25,
     ):
         from mx_rcnn_tpu.serve.registry import DEFAULT_MODEL, ModelRegistry
 
@@ -371,6 +439,7 @@ class ServeRunner:
         self._parity_box_tol = float(parity_box_tol)
         self._parity_score_tol = float(parity_score_tol)
         self._parity_margin = float(parity_margin)
+        self._parity_mask_tol = float(parity_mask_tol)
         self.parity: Dict[str, Dict] = {}  # model → last gate report
         # registry-resolution state
         self._slots: Dict[str, _ModelSlot] = {}
@@ -383,6 +452,14 @@ class ServeRunner:
         self.split_dispatches = 0
         self.split_completes = 0
         self.fetch_stall_s = 0.0  # wall time blocked in complete()'s fetch
+        # fetch-byte accounting (ISSUE 14): every complete() sums the
+        # nbytes of the host-copied output tree — the measured evidence
+        # for the device-postprocess fetch reduction, per model and in
+        # total.  last_fetch_bytes is the most recent complete()'s size
+        # (read by Replica._finish right after the call, same thread).
+        self.fetch_bytes_total = 0
+        self.fetch_bytes_by_model: Dict[str, int] = {}
+        self.last_fetch_bytes = 0
         # build the default slot eagerly: construction fails fast on a
         # bad config, and legacy callers read .predictor immediately
         self._slot(self.default_model)
@@ -448,7 +525,17 @@ class ServeRunner:
                 if self._device_postprocess is None
                 else self._device_postprocess
             )
-            if use_post and not cfg.network.USE_MASK:
+            if precision == "bf16" and cfg.network.USE_MASK \
+                    and not self._parity_check:
+                # a bf16 mask graph without the warmup parity gate would
+                # serve unverified mask grids — the gate is what checks
+                # them (check_parity compares grids of matched pairs)
+                raise ValueError(
+                    f"precision='bfloat16' for mask model {model_id!r} "
+                    f"requires parity_check=True (the warmup gate is "
+                    f"what verifies the mask grids against f32)"
+                )
+            if use_post:
                 from mx_rcnn_tpu.ops.postprocess import make_test_postprocess
 
                 post = make_test_postprocess(
@@ -613,6 +700,15 @@ class ServeRunner:
         out = host_copy(handle.outputs)
         self.fetch_stall_s += time.monotonic() - t0
         self.split_completes += 1
+        nbytes = sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves(out)
+        )
+        self.last_fetch_bytes = nbytes
+        self.fetch_bytes_total += nbytes
+        self.fetch_bytes_by_model[handle.model] = (
+            self.fetch_bytes_by_model.get(handle.model, 0) + nbytes
+        )
         return out
 
     def run(
@@ -752,12 +848,16 @@ class ServeRunner:
         )
         out_f32 = ref_predictor.predict(batch)
         thresh = float(slot.cfg.TEST.SCORE_THRESH)
-        dets_bf16 = self.detections_for(out_bf16, batch, 0, model=model)
-        ref_dets, _ = detections_from_output(
+        dets_bf16, masks_bf16 = self.detections_for(
+            out_bf16, batch, 0, model=model, with_masks=True
+        )
+        ref_dets, ref_masks = detections_from_output(
             out_f32, batch["im_info"][0], tuple(batch["orig_hw"][0]),
             e.cfg, slot.num_classes,
         )
-        ref_dets, _ = cap_detections(ref_dets, e.cfg.TEST.MAX_PER_IMAGE)
+        ref_dets, ref_masks = cap_detections(
+            ref_dets, e.cfg.TEST.MAX_PER_IMAGE, ref_masks
+        )
         report = detection_parity(
             ref_dets, dets_bf16, thresh, margin=self._parity_margin
         )
@@ -766,10 +866,21 @@ class ServeRunner:
             box_tol_px=self._parity_box_tol,
             score_tol=self._parity_score_tol,
         )
+        mask_ok = True
+        if e.cfg.network.USE_MASK:
+            # mask families must not pass the gate on boxes alone —
+            # compare the matched pairs' S×S probability grids too
+            report.update(mask_parity(
+                ref_dets, ref_masks or {}, dets_bf16, masks_bf16 or {},
+                thresh, margin=self._parity_margin,
+            ))
+            report["mask_tol"] = self._parity_mask_tol
+            mask_ok = report["max_mask_prob_delta"] <= self._parity_mask_tol
         ok = (
             report["unmatched_confident"] == 0
             and report["max_box_delta_px"] <= self._parity_box_tol
             and report["max_score_delta"] <= self._parity_score_tol
+            and mask_ok
         )
         report["ok"] = ok
         self.parity[mid] = report
@@ -844,15 +955,24 @@ class ServeRunner:
         orig_hw: Optional[Tuple[float, float]] = None,
         thresh: Optional[float] = None,
         model: Optional[str] = None,
+        with_masks: bool = False,
     ) -> ClsDets:
+        """Per-image capped detections; ``with_masks=True`` returns
+        ``(cls_dets, mask_probs)`` instead (mask_probs None for box
+        families) — the capped per-class grids ready for
+        ``eval/segm.py::rles_for_detections``."""
         slot = self._slot(self.default_model if model is None else model)
         if orig_hw is None:
             orig_hw = tuple(batch["orig_hw"][index])
-        cls_dets, _ = detections_from_output(
+        cls_dets, mask_probs = detections_from_output(
             out, batch["im_info"][index], orig_hw, slot.cfg,
             slot.num_classes, index=index, thresh=thresh,
         )
-        cls_dets, _ = cap_detections(cls_dets, slot.cfg.TEST.MAX_PER_IMAGE)
+        cls_dets, mask_probs = cap_detections(
+            cls_dets, slot.cfg.TEST.MAX_PER_IMAGE, mask_probs
+        )
+        if with_masks:
+            return cls_dets, mask_probs
         return cls_dets
 
     # ---- synchronous single image (demo path)

@@ -18,6 +18,13 @@ Examples:
   # mid-load hot-swap of it (the ``swap <model> <ckpt>`` admin command)
   python -m mx_rcnn_tpu.tools.serve --small \
       --model tenant=vgg:random:1 --swap tenant=ckpts/epoch_0002
+
+  # mask family as a tenant: device postprocess ships selected
+  # ``det_masks`` grids, not the raw (R, S, S, K) stack (ISSUE 14);
+  # ``make serve-mask`` runs this shape through bench.py with the
+  # fetch-byte counters on
+  python -m mx_rcnn_tpu.tools.serve --small \
+      --model masks=mask_resnet_fpn:random:1
 """
 
 from __future__ import annotations
@@ -154,7 +161,10 @@ def main():
     p.add_argument("--precision", default="float32",
                    choices=["float32", "bfloat16"],
                    help="serve-graph compute dtype; bfloat16 also folds "
-                   "BN and is parity-gated against f32 at warmup")
+                   "BN and is parity-gated against f32 at warmup (mask "
+                   "families: the gate compares S×S mask grids too, and "
+                   "the runner refuses bf16 mask models with the gate "
+                   "disabled)")
     p.add_argument("--response_cache", type=int, default=0, metavar="N",
                    help="idempotent response cache capacity (entries); "
                    "0 disables.  Keyed by image digest per (model, "

@@ -133,6 +133,39 @@ def test_r1_silent_on_split_complete_host_copy():
     assert run_rule(R1_SPLIT_GOOD, HostCopyEscape()) == []
 
 
+# R1 against the ISSUE 14 mask-fetch shape: the selected det_masks
+# tensor crosses to host exactly once, through the owning-copy
+# discipline — a bare device_get view of the grids escaping complete()
+# is the regression the rule must keep catching
+R1_MASK_BAD = """
+import jax
+
+class Runner:
+    def complete(self, handle):
+        out = jax.device_get(handle.outputs)
+        return out["det_masks"]
+"""
+
+R1_MASK_GOOD = """
+from mx_rcnn_tpu.core.resilience import host_copy
+
+class Runner:
+    def complete(self, handle):
+        out = host_copy(handle.outputs)
+        return out["det_masks"]
+"""
+
+
+def test_r1_fires_on_mask_fetch_device_get_view():
+    fs = run_rule(R1_MASK_BAD, HostCopyEscape())
+    assert len(fs) == 1 and fs[0].rule == "R1"
+    assert fs[0].scope == "Runner.complete"
+
+
+def test_r1_silent_on_mask_fetch_host_copy():
+    assert run_rule(R1_MASK_GOOD, HostCopyEscape()) == []
+
+
 # ---------------------------------------------------------------- R2
 
 R2_BAD = """
@@ -976,3 +1009,48 @@ def test_overlap_artifact_schema_guard(tmp_path):
     assert "'byte_identical' missing" in errs
     assert "depth2.device_busy_fraction missing" in errs
     assert "no record metric 'serve_overlap_speedup*'" in errs
+
+
+def test_mask_artifact_schema_guard(tmp_path):
+    """BENCH_serve_mask_cpu.json must carry the three ISSUE 14 closure
+    claims — all true — plus the measured fetch-byte evidence and the
+    serve_mask metric records."""
+    claims = {
+        "fetch_reduction_ge_5x": True,
+        "rle_byte_identical": True,
+        "zero_steady_state_recompiles": True,
+    }
+    good = {
+        "records": [
+            {"metric": m, "value": 1}
+            for m in ("serve_mask_p50_ms",
+                      "serve_mask_p99_ms",
+                      "serve_mask_fetch_bytes_per_batch_raw",
+                      "serve_mask_fetch_bytes_per_batch_device",
+                      "serve_mask_fetch_reduction",
+                      "serve_mask_rle_byte_identical",
+                      "serve_mask_steady_state_compile_misses")
+        ],
+        "report": {
+            "claims": dict(claims),
+            "fetch_bytes": {
+                "raw_per_batch": 3237120.0,
+                "device_per_batch": 205056.0,
+                "reduction": 15.79,
+            },
+        },
+    }
+    art = tmp_path / "BENCH_serve_mask_cpu.json"
+    art.write_text(json.dumps(good))
+    assert check_bench_artifacts(tmp_path) == []
+
+    good["report"]["claims"]["fetch_reduction_ge_5x"] = False
+    del good["report"]["claims"]["rle_byte_identical"]
+    del good["report"]["fetch_bytes"]["reduction"]
+    good["records"] = good["records"][1:]
+    art.write_text(json.dumps(good))
+    errs = " | ".join(check_bench_artifacts(tmp_path))
+    assert "'fetch_reduction_ge_5x' not true" in errs
+    assert "'rle_byte_identical' missing" in errs
+    assert "fetch_bytes incomplete" in errs
+    assert "no record metric 'serve_mask_p50_ms*'" in errs

@@ -62,6 +62,7 @@ class SplitRunner(FakeRunner):
     def __init__(self, index: int = 0, service_s: float = 0.0):
         super().__init__(index, service_s=service_s)
         self.complete_gate: "threading.Event | None" = None
+        self.dispatch_gate: "threading.Event | None" = None
         self.dispatched = 0
         self.completed = 0
 
@@ -75,6 +76,13 @@ class SplitRunner(FakeRunner):
         return [out["digest"][index].copy()]
 
     def dispatch(self, batch, model=None):
+        gate = self.dispatch_gate
+        if gate is not None:
+            # hold the first dispatch open until the test has enqueued
+            # the whole window — without this the loop thread can win
+            # the race against the second submit(), pull the lone entry
+            # into the (gated) complete, and never fill the window
+            gate.wait(10.0)
         if self.service_s:
             time.sleep(self.service_s)
         self.compile_cache.record((batch["images"].shape, "f32"))
@@ -165,6 +173,72 @@ def test_depth2_byte_identical_to_depth1_across_buckets_models_lanes():
     assert snap2["overlap"]["inflight_depth"] == 2
 
 
+# ---------------------------------------- fetch-byte accounting (ISSUE 14)
+
+class ByteCountingRunner(SplitRunner):
+    """SplitRunner that reports a per-complete fetch size the way
+    ServeRunner does (``last_fetch_bytes``, read by Replica._finish
+    right after the call)."""
+
+    FETCH_BYTES = 1000
+
+    def complete(self, handle):
+        out = super().complete(handle)
+        self.last_fetch_bytes = self.FETCH_BYTES
+        return out
+
+
+def test_fetch_bytes_counted_per_complete_and_merged_across_pool():
+    from mx_rcnn_tpu.serve.metrics import OverlapStats
+
+    # unit: note_fetch accumulates per model and surfaces in snapshot()
+    stats = OverlapStats()
+    stats.note_fetch(0.001, hidden=False, nbytes=100, model="masks")
+    stats.note_fetch(0.001, hidden=True, nbytes=50, model="masks")
+    stats.note_fetch(0.001, hidden=False, nbytes=7)  # model-less complete
+    snap = stats.snapshot()
+    assert snap["fetch_bytes"] == 157
+    assert snap["fetch_bytes_by_model"] == {"masks": 150, "default": 7}
+    # zero-byte notes (stub runners without the counter) change nothing
+    stats.note_fetch(0.001, hidden=False)
+    assert stats.snapshot()["fetch_bytes"] == 157
+
+    # end to end: every complete() through the pool lands in the merged
+    # overlap block of the pool snapshot
+    n = 6
+    pool = ReplicaPool(
+        lambda i: ByteCountingRunner(i), n_replicas=2, policy=FAST,
+        inflight_depth=2,
+    )
+    with ServingEngine(pool, max_linger=0.005, in_flight=4) as engine:
+        report = run_load(
+            engine, num_requests=n, concurrency=3, sizes=SIZES, seed=0
+        )
+    snap = pool.snapshot()
+    pool.close()
+    assert report["outcomes"]["ok"] == n
+    batches = snap["overlap"]["fetches"]
+    assert snap["overlap"]["fetch_bytes"] == \
+        batches * ByteCountingRunner.FETCH_BYTES
+    assert sum(snap["overlap"]["fetch_bytes_by_model"].values()) == \
+        snap["overlap"]["fetch_bytes"]
+
+
+def test_stub_runners_without_counter_keep_zero_fetch_bytes():
+    # legacy/stub runners (no last_fetch_bytes attr) must not break the
+    # replica's accounting — bytes just stay 0
+    pool = ReplicaPool(split_factory, n_replicas=1, policy=FAST,
+                       inflight_depth=1)
+    with ServingEngine(pool, max_linger=0.005) as engine:
+        report = run_load(engine, num_requests=3, concurrency=2,
+                          sizes=SIZES, seed=0)
+    snap = pool.snapshot()
+    pool.close()
+    assert report["outcomes"]["ok"] == 3
+    assert snap["overlap"]["fetch_bytes"] == 0
+    assert snap["overlap"]["fetch_bytes_by_model"] == {}
+
+
 # -------------------------------------------- trip with a full window
 
 def test_trip_with_two_inflight_requeues_both_exactly_once(no_faults):
@@ -172,10 +246,13 @@ def test_trip_with_two_inflight_requeues_both_exactly_once(no_faults):
     try:
         wait_for(lambda: r.state is ReplicaState.HEALTHY, msg="healthy")
         gate = threading.Event()
+        dgate = threading.Event()
         r.runner.complete_gate = gate
+        r.runner.dispatch_gate = dgate
         ref = SplitRunner()
         d1 = r.submit(one_image_batch(ref, 1))
         d2 = r.submit(one_image_batch(ref, 2))
+        dgate.set()  # both enqueued — the loop can fill the window now
         # both dispatch halves ran; the oldest is stuck in complete()
         wait_for(lambda: len(r._inflight) == 2, msg="window full")
         r.trip("operator-drain-test")
@@ -198,6 +275,7 @@ def test_trip_with_two_inflight_requeues_both_exactly_once(no_faults):
         assert got.tobytes() == expect.tobytes()
     finally:
         r.runner.complete_gate = None
+        r.runner.dispatch_gate = None
         r.stop()
 
 
@@ -256,10 +334,13 @@ def test_quarantine_suspects_span_the_whole_window(no_faults):
     try:
         wait_for(lambda: r.state is ReplicaState.HEALTHY, msg="healthy")
         gate = threading.Event()
+        dgate = threading.Event()
         r.runner.complete_gate = gate
+        r.runner.dispatch_gate = dgate
         ref = SplitRunner()
         d1 = r.submit(one_image_batch(ref, 1), digests=("window-digest-a",))
         d2 = r.submit(one_image_batch(ref, 2), digests=("window-digest-b",))
+        dgate.set()  # both enqueued — the loop can fill the window now
         wait_for(lambda: len(r._inflight) == 2, msg="window full")
         r.trip("stall-attribution-test")
         gate.set()
@@ -274,6 +355,7 @@ def test_quarantine_suspects_span_the_whole_window(no_faults):
                 d.future.result(timeout=5.0)
     finally:
         r.runner.complete_gate = None
+        r.runner.dispatch_gate = None
         r.stop()
 
 

@@ -82,6 +82,154 @@ class TestDevicePostprocessEquivalence:
                 )
 
 
+def _fake_mask_outputs(rng, b=2, r=64, k=5, s=14):
+    """Raw head outputs plus a per-roi (S, S, K) mask-logit stack."""
+    out, im_info = _fake_outputs(rng, b=b, r=r, k=k)
+    out["mask_logits"] = (rng.randn(b, r, s, s, k) * 3).astype(np.float32)
+    return out, im_info
+
+
+class TestDeviceMaskSelection:
+    """ISSUE 14: the fused postprocess gathers each survivor's S×S grid
+    for its predicted class on device; the host only applies sigmoid +
+    paste + RLE.  The bar is BIT parity with the reference host chain
+    (im_detect → threshold → NMS → cap), not approximate equality."""
+
+    def _cfg(self, max_per_image=10):
+        cfg = generate_config("resnet50", "PascalVOC")
+        return cfg.replace(
+            TEST=dataclasses.replace(cfg.TEST, MAX_PER_IMAGE=max_per_image)
+        )
+
+    def _run_device(self, cfg, out, im_info, orig_hw, k, max_out=32):
+        fn = make_test_postprocess(cfg, k, 0.05, max_out=max_out)
+        return {
+            kk: np.asarray(v)
+            for kk, v in fn(
+                {kk: jnp.asarray(v) for kk, v in out.items()},
+                jnp.asarray(im_info), jnp.asarray(orig_hw),
+            ).items()
+        }
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_mask_probs_bitwise_and_rles_byte_identical(self, seed):
+        from mx_rcnn_tpu.eval.segm import rles_for_detections
+        from mx_rcnn_tpu.serve.runner import (
+            cap_detections, detections_from_output,
+        )
+
+        cfg = self._cfg()
+        k = 5
+        rng = np.random.RandomState(seed)
+        out, im_info = _fake_mask_outputs(rng, k=k)
+        orig_hw = np.stack(
+            [np.floor(im_info[:, 0] / im_info[:, 2]),
+             np.floor(im_info[:, 1] / im_info[:, 2])], axis=1
+        ).astype(np.float32)
+        dev = self._run_device(cfg, out, im_info, orig_hw, k)
+        assert dev["det_masks"].shape[1] == cfg.TEST.MAX_PER_IMAGE
+        assert dev["det_masks"].dtype == np.float32
+
+        for b in range(out["rois"].shape[0]):
+            h, w = int(orig_hw[b][0]), int(orig_hw[b][1])
+            d_dets, d_masks = detections_from_output(
+                dev, im_info[b], tuple(orig_hw[b]), cfg, k, index=b
+            )
+            d_dets, d_masks = cap_detections(
+                d_dets, cfg.TEST.MAX_PER_IMAGE, d_masks
+            )
+            r_dets, r_masks = detections_from_output(
+                out, im_info[b], tuple(orig_hw[b]), cfg, k, index=b
+            )
+            r_dets, r_masks = cap_detections(
+                r_dets, cfg.TEST.MAX_PER_IMAGE, r_masks
+            )
+            assert sum(len(d) for d in r_dets[1:]) > 0
+            for j in range(1, k):
+                assert len(d_dets[j]) == len(r_dets[j]), f"img {b} cls {j}"
+                if len(d_dets[j]) == 0:
+                    continue
+                # scores and mask probabilities are pure gathers +
+                # the identical numpy sigmoid: bitwise equal
+                assert d_dets[j][:, 4].tobytes() == r_dets[j][:, 4].tobytes()
+                assert d_masks[j].tobytes() == r_masks[j].tobytes(), (
+                    f"img {b} cls {j}: device-selected mask grids differ "
+                    f"from the host-path grids"
+                )
+                # boxes carry the XLA-vs-numpy decode ulp only
+                np.testing.assert_allclose(
+                    d_dets[j][:, :4], r_dets[j][:, :4], atol=1e-4
+                )
+                d_rles = rles_for_detections(d_masks[j], d_dets[j], h, w)
+                r_rles = rles_for_detections(r_masks[j], r_dets[j], h, w)
+                assert len(d_rles) == len(r_rles)
+                for ra, rb in zip(d_rles, r_rles):
+                    assert ra["size"] == rb["size"]
+                    assert ra["counts"] == rb["counts"], (
+                        f"img {b} cls {j}: RLE bytes differ"
+                    )
+
+    def test_padding_row_invariance(self):
+        """Appending invalid padding rois (a bigger bucket's R) must not
+        change a single selected-mask bit."""
+        cfg = self._cfg()
+        k, r, pad = 5, 48, 24
+        rng = np.random.RandomState(3)
+        out, im_info = _fake_mask_outputs(rng, r=r, k=k)
+        orig_hw = np.stack(
+            [np.floor(im_info[:, 0] / im_info[:, 2]),
+             np.floor(im_info[:, 1] / im_info[:, 2])], axis=1
+        ).astype(np.float32)
+        b = out["rois"].shape[0]
+        padded = {
+            "rois": np.concatenate(
+                [out["rois"], np.zeros((b, pad, 4), np.float32)], axis=1
+            ),
+            "roi_valid": np.concatenate(
+                [out["roi_valid"], np.zeros((b, pad), bool)], axis=1
+            ),
+            "cls_prob": np.concatenate(
+                [out["cls_prob"],
+                 rng.rand(b, pad, k).astype(np.float32)], axis=1
+            ),
+            "bbox_deltas": np.concatenate(
+                [out["bbox_deltas"],
+                 rng.randn(b, pad, 4 * k).astype(np.float32)], axis=1
+            ),
+            "mask_logits": np.concatenate(
+                [out["mask_logits"],
+                 rng.randn(b, pad, 14, 14, k).astype(np.float32)], axis=1
+            ),
+        }
+        base = self._run_device(cfg, out, im_info, orig_hw, k)
+        wide = self._run_device(cfg, padded, im_info, orig_hw, k)
+        for key in ("det_masks", "det_mask_idx", "det_mask_valid"):
+            assert base[key].tobytes() == wide[key].tobytes(), key
+
+    def test_invalid_rows_are_inert_fill(self):
+        """Past the valid survivors: idx −1, valid False, and the large-
+        negative logit fill (sigmoid ≈ 0 → empty mask, no exp overflow
+        if one ever leaks to the host paste)."""
+        # cap above the det-grid supply: max_det clamps to (K-1)*max_out
+        cfg = self._cfg(max_per_image=64)
+        k = 5
+        rng = np.random.RandomState(4)
+        out, im_info = _fake_mask_outputs(rng, r=16, k=k)
+        orig_hw = np.stack(
+            [np.floor(im_info[:, 0] / im_info[:, 2]),
+             np.floor(im_info[:, 1] / im_info[:, 2])], axis=1
+        ).astype(np.float32)
+        dev = self._run_device(cfg, out, im_info, orig_hw, k, max_out=8)
+        assert dev["det_masks"].shape == (2, 32, 14, 14)
+        inv = ~dev["det_mask_valid"]
+        assert inv.any()
+        assert (dev["det_mask_idx"][inv] == -1).all()
+        assert (dev["det_masks"][inv] == -80.0).all()
+        with np.errstate(over="raise"):
+            probs = 1.0 / (1.0 + np.exp(-dev["det_masks"][inv]))
+        assert (probs < 1e-30).all()
+
+
 class TestUint8Transfer:
     def test_prepare_image_uint8_roundtrip(self):
         from mx_rcnn_tpu.data.image import prepare_image
