@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault serve-mask swap slo poison pipeline elastic chaos integration-gate clean-native
+.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault serve-mask serve-scale swap slo poison pipeline elastic chaos integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -100,6 +100,16 @@ serve-mask:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve_mask --serve_requests 24 \
 	      --serve_concurrency 6 --serve_max_batch 4 \
 	      --out BENCH_serve_mask_cpu.json
+
+# tenant-fair front door bench (ISSUE 16): aggressor/victim isolation
+# with the aggressor blasting 4x its token-bucket rate (victim p99 must
+# hold within 10%), an autoscaler-initiated scale-down under live load
+# that loses zero requests and stays byte-identical to a fixed-size
+# control, diurnal + oscillating trace convergence through the flap
+# breaker, and zero steady-state recompiles at every pool size
+serve-scale:
+	JAX_PLATFORMS=cpu $(PY) bench.py --serve_scale \
+	      --out BENCH_serve_scale_cpu.json
 
 # fault-matrix serving bench (ISSUE 6): the same deterministic load
 # against a 3-replica health-gated pool under healthy / wedged-replica /

@@ -2304,6 +2304,300 @@ def bench_elastic(steps: int, batch_images: int) -> tuple:
     return _elastic_records(report), report
 
 
+# -------------------------------------------------- tenant-fair front door
+class _ScalePool:
+    """Signal-only pool stand-in for the trace-convergence legs: the
+    autoscaler sees a replicas list and add/remove with the real
+    copy-on-write contract, without paying replica threads for a
+    decision-loop simulation."""
+
+    def __init__(self, n: int):
+        self.replicas = [object() for _ in range(n)]
+
+    def add_replica(self):
+        r = object()
+        self.replicas = self.replicas + [r]
+        return r
+
+    def remove_replica(self, replica=None, timeout=5.0):
+        if len(self.replicas) <= 1:
+            return None
+        victim = self.replicas[-1]
+        self.replicas = self.replicas[:-1]
+        return victim
+
+
+def _drive_trace(scaler, depths, dt: float = 0.1):
+    """Feed a queue-depth series through synchronous ticks (injected
+    clock — wall time never enters the convergence legs)."""
+    now = 1000.0
+    for d in depths:
+        scaler._signal_fn = lambda d=d: {
+            "queue_depth": d,
+            "healthy": len(scaler.pool.replicas),
+            "p99_ms": None,
+        }
+        scaler.tick(now=now)
+        now += dt
+
+
+def bench_serve_scale(
+    requests: int = 60,
+    aggressor_factor: int = 4,
+    service_ms: float = 3.0,
+) -> tuple:
+    """Tenant-fair front door bench (ISSUE 16 acceptance evidence).
+
+    Four claims over the calibrated digest-stub runner family:
+
+    1. ``tenant_isolation`` — the victim's p99 with an aggressor
+       blasting at ``aggressor_factor``x its token-bucket rate stays
+       within 10% (+2ms measurement floor) of the victim-solo run,
+       because the excess is rejected at the door, never queued;
+    2. ``zero_loss_shrink`` — an AUTOSCALER-initiated scale-down in the
+       middle of live pool load completes every request with detections
+       byte-identical to a fixed-size control run;
+    3. ``no_flap`` — the controller converges on a diurnal trace with a
+       bounded event count and zero flaps, and the breaker engages
+       (flaps detected, events suppressed) on an adversarial
+       oscillating trace;
+    4. ``zero_steady_state_recompiles`` — compile misses across the
+       shrink leg stay at warmup level for every pool size, and a
+       scale-up costs exactly one ladder warmup, never more.
+    """
+    from mx_rcnn_tpu.serve.autoscaler import AutoScaler, ScalePolicy
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.loadgen import diurnal_arrivals
+    from mx_rcnn_tpu.serve.router import ReplicaPool
+    from mx_rcnn_tpu.serve.tenancy import TenantOverBudget, TenantTable
+
+    tag = "cpu"
+    service_s = service_ms / 1000.0
+
+    def stub_factory(index: int):
+        return _OverlapStubRunner(index, h2d_ms=0.0,
+                                  device_ms=service_ms, fetch_ms=0.0)
+
+    def images(n):
+        return [
+            np.random.RandomState(3000 + i).rand(24, 24, 3).astype(
+                np.float32
+            )
+            for i in range(n)
+        ]
+
+    # ---- leg 1: aggressor/victim isolation -----------------------------
+    def victim_run(with_aggressor: bool):
+        tenants = TenantTable(strict=True)
+        tenants.register("victim", weight=1.0)
+        tenants.register("aggressor", weight=1.0, rate=50.0, burst=5.0)
+        engine = ServingEngine(
+            _OverlapStubRunner(0, h2d_ms=0.0, device_ms=service_ms,
+                               fetch_ms=0.0),
+            max_linger=0.0, max_queue=256, in_flight=1, tenants=tenants,
+        )
+        shed = 0
+        lats_ms = []
+        with engine:
+            futs = []
+            # warm phase (unmeasured): drain the aggressor's one-time
+            # token-bucket burst so the measured window is the steady
+            # state the isolation claim is about — the aggressor held
+            # to its refill rate, the excess shed at the door
+            for i, im in enumerate(images(8)):
+                if with_aggressor:
+                    for _ in range(aggressor_factor):
+                        try:
+                            futs.append(
+                                engine.submit(im, tenant="aggressor",
+                                              lane="bulk")
+                            )
+                        except TenantOverBudget:
+                            shed += 1
+                engine.submit(im, tenant="victim",
+                              lane="interactive").result(timeout=30.0)
+            for i, im in enumerate(images(requests)):
+                if with_aggressor:
+                    for _ in range(aggressor_factor):
+                        try:
+                            futs.append(
+                                engine.submit(im, tenant="aggressor",
+                                              lane="bulk")
+                            )
+                        except TenantOverBudget:
+                            shed += 1
+                t0 = time.monotonic()
+                vf = engine.submit(im, tenant="victim",
+                                   lane="interactive")
+                vf.result(timeout=30.0)
+                lats_ms.append((time.monotonic() - t0) * 1000.0)
+            for f in futs:
+                f.result(timeout=30.0)
+        return _pctl_ms(lats_ms, 99), shed, engine.snapshot()
+
+    solo_p99, _, _ = victim_run(with_aggressor=False)
+    duo_p99, agg_shed, duo_snap = victim_run(with_aggressor=True)
+    # the 10% bar plus one device-service quantum: the WFQ guarantees
+    # at most one aggressor batch ahead of a victim release, and CPU
+    # wall-clock needs a jitter floor on top of the ratio
+    isolation_bar = 1.10 * solo_p99 + service_ms + 2.0
+    tenant_isolation = bool(duo_p99 <= isolation_bar and agg_shed > 0)
+
+    # ---- leg 2: autoscaler-initiated zero-loss scale-down --------------
+    ims = images(requests)
+
+    ladder_len = len(_OverlapStubRunner.LADDER)
+
+    def pool_run(autoscale: bool):
+        pool = ReplicaPool(stub_factory, 2)
+        engine = ServingEngine(pool, max_linger=0.0, max_queue=256,
+                               in_flight=1)
+        try:
+            with engine:
+                futs = [engine.submit(im) for im in ims]
+                scaler = None
+                if autoscale:
+                    # shrink-biased policy: the controller pulls the
+                    # pool to min_replicas while the load is in flight
+                    scaler = engine.attach_autoscaler(
+                        policy=ScalePolicy(
+                            min_replicas=1, max_replicas=2,
+                            interval=0.005, samples=2, cooldown=0.0,
+                            up_queue=1e9, down_queue=1e9,
+                        )
+                    )
+                results = [f.result(timeout=60.0) for f in futs]
+                # steady state at whatever size the pool landed on:
+                # every surviving replica carries exactly its warmup
+                # compiles, nothing from traffic
+                extra = sum(
+                    r.runner.compile_cache.misses - ladder_len
+                    for r in pool.replicas
+                )
+                down_events = scaler.scale_downs if scaler else 0
+                n_after = len(pool.replicas)
+            snap = engine.snapshot()
+        finally:
+            pool.close()
+        return results, snap, extra, down_events, n_after
+
+    fixed_res, fixed_snap, fixed_extra, _, _ = pool_run(autoscale=False)
+    (scaled_res, scaled_snap, scaled_extra,
+     down_events, n_after) = pool_run(autoscale=True)
+    identical = all(
+        _dets_equal(a, b) for a, b in zip(fixed_res, scaled_res)
+    )
+    zero_loss = bool(
+        identical
+        and down_events >= 1
+        and n_after == 1
+        and scaled_snap["requests"]["completed"] == requests
+        and scaled_snap["requests"]["failed"] == 0
+    )
+    # steady state must not compile at either pool size; a grow costs
+    # exactly one ladder warmup
+    shrink_recompiles = scaled_extra + fixed_extra
+    pool2 = ReplicaPool(stub_factory, 1)
+    try:
+        pool2.warmup()
+        grow_before = pool2.compile_cache.misses
+        r = pool2.add_replica()
+        t_end = time.monotonic() + 10.0
+        while not r.routable and time.monotonic() < t_end:
+            time.sleep(0.01)
+        grow_delta = pool2.compile_cache.misses - grow_before
+    finally:
+        pool2.close()
+    zero_recompiles = bool(
+        shrink_recompiles == 0 and grow_delta == ladder_len
+    )
+
+    # ---- leg 3: trace convergence + breaker ----------------------------
+    # diurnal day: arrivals binned to ticks -> queue-depth series
+    arr = np.asarray(
+        diurnal_arrivals(2000, lo_rps=4.0, hi_rps=40.0, seed=7)
+    )
+    bins = np.histogram(arr, bins=120)[0]  # ~arrivals per tick
+    pol = ScalePolicy(min_replicas=1, max_replicas=4, samples=3,
+                      up_queue=10.0, down_queue=2.0,
+                      cooldown=0.5, flap_window=2.0, max_backoff=4.0)
+    diurnal_pool = _ScalePool(1)
+    diurnal_scaler = AutoScaler(diurnal_pool, policy=pol)
+    _drive_trace(diurnal_scaler, bins.tolist(), dt=0.5)
+    diurnal_events = diurnal_scaler.scale_ups + diurnal_scaler.scale_downs
+    diurnal_flaps = diurnal_scaler.breaker.flaps
+
+    osc_pool = _ScalePool(2)
+    osc_scaler = AutoScaler(osc_pool, policy=ScalePolicy(
+        min_replicas=1, max_replicas=4, samples=2,
+        cooldown=0.5, flap_window=100.0, max_backoff=4.0,
+    ))
+    osc = ([100.0] * 3 + [0.0] * 3) * 10  # adversarial square wave
+    _drive_trace(osc_scaler, osc, dt=0.1)
+    osc_events = osc_scaler.scale_ups + osc_scaler.scale_downs
+    osc_snap = osc_scaler.snapshot()["breaker"]
+    no_flap = bool(
+        diurnal_flaps == 0
+        and 2 <= diurnal_events <= 10
+        and osc_events <= 6
+        and osc_snap["flaps"] >= 1
+        and osc_snap["suppressed"] >= 5
+    )
+
+    records = [
+        {"metric": f"serve_scale_victim_solo_p99_ms_{tag}",
+         "value": solo_p99, "unit": "ms"},
+        {"metric": f"serve_scale_victim_contended_p99_ms_{tag}",
+         "value": duo_p99, "unit": "ms"},
+        {"metric": f"serve_scale_aggressor_shed_{tag}",
+         "value": agg_shed, "unit": "requests"},
+        {"metric": f"serve_scale_shrink_lost_requests_{tag}",
+         "value": requests - scaled_snap["requests"]["completed"],
+         "unit": "requests"},
+        {"metric": f"serve_scale_shrink_scale_downs_{tag}",
+         "value": down_events, "unit": "events"},
+        {"metric": f"serve_scale_detections_match_{tag}",
+         "value": 1 if identical else 0, "unit": "bool"},
+        {"metric": f"serve_scale_shrink_recompiles_{tag}",
+         "value": shrink_recompiles, "unit": "compiles"},
+        {"metric": f"serve_scale_grow_warmup_compiles_{tag}",
+         "value": grow_delta, "unit": "compiles"},
+        {"metric": f"serve_scale_diurnal_events_{tag}",
+         "value": diurnal_events, "unit": "events"},
+        {"metric": f"serve_scale_diurnal_flaps_{tag}",
+         "value": diurnal_flaps, "unit": "flaps"},
+        {"metric": f"serve_scale_oscillating_events_{tag}",
+         "value": osc_events, "unit": "events"},
+        {"metric": f"serve_scale_oscillating_suppressed_{tag}",
+         "value": osc_snap["suppressed"], "unit": "ticks"},
+    ]
+    report = {
+        "requests": requests,
+        "aggressor_factor": aggressor_factor,
+        "service_ms": service_ms,
+        "isolation_bar_ms": round(isolation_bar, 3),
+        "victim": {"solo_p99_ms": solo_p99, "contended_p99_ms": duo_p99},
+        "aggressor": duo_snap["tenants"]["aggressor"],
+        "tenancy": duo_snap["tenancy"],
+        "shrink": {
+            "scale_downs": down_events,
+            "replicas_after": n_after,
+            "completed": scaled_snap["requests"]["completed"],
+            "autoscaler": scaled_snap.get("autoscaler"),
+        },
+        "diurnal": {"events": diurnal_events, "flaps": diurnal_flaps,
+                    "replicas_final": len(diurnal_pool.replicas)},
+        "oscillating": {"events": osc_events, "breaker": osc_snap},
+        "claims": {
+            "tenant_isolation": tenant_isolation,
+            "zero_loss_shrink": zero_loss,
+            "no_flap": no_flap,
+            "zero_steady_state_recompiles": zero_recompiles,
+        },
+    }
+    return records, report
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -2351,6 +2645,15 @@ def main():
     ap.add_argument("--overlap_fetch_ms", type=float, default=25.0,
                     help="stub D2H fetch + host postprocess per batch "
                          "for --serve_overlap")
+    ap.add_argument(
+        "--serve_scale", action="store_true",
+        help="tenant-fair front door bench (ISSUE 16): aggressor/victim "
+             "isolation under a 4x rate-limit blast, autoscaler-"
+             "initiated zero-loss scale-down (byte-identical to a "
+             "fixed-size control), diurnal/oscillating trace "
+             "convergence through the flap breaker, and zero steady-"
+             "state recompiles at every pool size",
+    )
     ap.add_argument(
         "--serve_mask", action="store_true",
         help="mask-family serving bench (ISSUE 14): device-side mask "
@@ -2542,6 +2845,17 @@ def main():
             concurrency=args.serve_concurrency // 2 or 8,
             device_ms=args.overlap_device_ms,
             fetch_ms=args.overlap_fetch_ms,
+        )
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
+
+    if args.serve_scale:
+        records, report = bench_serve_scale(
+            requests=args.serve_requests,
         )
         for rec in records:
             print(json.dumps(rec), flush=True)

@@ -252,6 +252,66 @@ def _check_mask_schema(name: str, doc: dict) -> List[str]:
     return errors
 
 
+# tenant-fair front door bench (ISSUE 16): the scale artifact must
+# carry the four closure claims — victim p99 isolation under an
+# aggressor blast, the autoscaler-initiated zero-loss byte-identical
+# scale-down, bounded no-flap trace convergence with the breaker
+# engaging on the oscillating trace, and zero steady-state recompiles
+# at every pool size — plus the victim latency evidence the isolation
+# claim rests on.
+_SCALE_CLAIMS = (
+    "tenant_isolation",
+    "zero_loss_shrink",
+    "no_flap",
+    "zero_steady_state_recompiles",
+)
+
+_SCALE_METRIC_PREFIXES = (
+    "serve_scale_victim_solo_p99_ms",
+    "serve_scale_victim_contended_p99_ms",
+    "serve_scale_aggressor_shed",
+    "serve_scale_shrink_lost_requests",
+    "serve_scale_detections_match",
+    "serve_scale_shrink_recompiles",
+    "serve_scale_diurnal_events",
+    "serve_scale_oscillating_events",
+)
+
+
+def _check_scale_schema(name: str, doc: dict) -> List[str]:
+    errors = []
+    report = doc.get("report") if isinstance(doc, dict) else None
+    if not isinstance(report, dict):
+        return [f"bench artifact {name}: missing report object"]
+    claims = report.get("claims")
+    if not isinstance(claims, dict):
+        return [f"bench artifact {name}: report.claims missing"]
+    for c in _SCALE_CLAIMS:
+        if c not in claims:
+            errors.append(f"bench artifact {name}: claim '{c}' missing")
+        elif claims[c] is not True:
+            errors.append(f"bench artifact {name}: claim '{c}' not true")
+    victim = report.get("victim")
+    if not isinstance(victim, dict) or not {
+        "solo_p99_ms", "contended_p99_ms"
+    } <= set(victim):
+        errors.append(
+            f"bench artifact {name}: report.victim incomplete — the "
+            f"isolation claim has no latency evidence"
+        )
+    metrics = {
+        r.get("metric", "")
+        for r in doc.get("records", [])
+        if isinstance(r, dict)
+    }
+    for prefix in _SCALE_METRIC_PREFIXES:
+        if not any(m.startswith(prefix) for m in metrics):
+            errors.append(
+                f"bench artifact {name}: no record metric '{prefix}*'"
+            )
+    return errors
+
+
 def check_bench_artifacts(root: Path) -> List[str]:
     errors = []
     for f in sorted(root.glob("BENCH_*.json")):
@@ -273,6 +333,8 @@ def check_bench_artifacts(root: Path) -> List[str]:
             errors += _check_overlap_schema(f.name, doc)
         if f.name == "BENCH_serve_mask_cpu.json":
             errors += _check_mask_schema(f.name, doc)
+        if f.name == "BENCH_serve_scale_cpu.json":
+            errors += _check_scale_schema(f.name, doc)
     return errors
 
 

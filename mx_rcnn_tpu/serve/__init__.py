@@ -15,10 +15,17 @@ ISSUE 7 adds the model lifecycle: ``registry`` owns versioned model
 state (LOADING → VERIFYING → WARMING → LIVE → RETIRED) with background
 hot-swap, automatic rollback, and multi-tenant ``(model, version)``
 resolution through the same batcher and pool.
+ISSUE 16 adds the front door: ``tenancy`` (token-bucket admission +
+weighted-fair release + shed-over-budget-first), ``frontend`` (the
+length-prefixed wire protocol with a typed error taxonomy), and
+``autoscaler`` (elastic replica count with a flap breaker and zero-loss
+scale-down).
 See SERVING.md for the architecture and failure semantics.
 """
 
+from mx_rcnn_tpu.serve.autoscaler import AutoScaler, ScaleBreaker, ScalePolicy
 from mx_rcnn_tpu.serve.batcher import DynamicBatcher, QueueFull, Request
+from mx_rcnn_tpu.serve.frontend import Frontend, FrontendClient
 from mx_rcnn_tpu.serve.buckets import (
     BucketLadder,
     BucketOverflow,
@@ -51,8 +58,16 @@ from mx_rcnn_tpu.serve.replica import (
 )
 from mx_rcnn_tpu.serve.router import NoHealthyReplica, ReplicaPool
 from mx_rcnn_tpu.serve.runner import ServeRunner
+from mx_rcnn_tpu.serve.tenancy import (
+    TenantOverBudget,
+    TenantPolicy,
+    TenantTable,
+    UnknownTenant,
+    WeightedFairScheduler,
+)
 
 __all__ = [
+    "AutoScaler",
     "BucketLadder",
     "BucketOverflow",
     "CompileCache",
@@ -60,6 +75,8 @@ __all__ = [
     "DeadlineExceeded",
     "DynamicBatcher",
     "EngineStopped",
+    "Frontend",
+    "FrontendClient",
     "HealthPolicy",
     "LatencyHistogram",
     "ModelRegistry",
@@ -72,6 +89,8 @@ __all__ = [
     "ReplicaPool",
     "ReplicaState",
     "Request",
+    "ScaleBreaker",
+    "ScalePolicy",
     "ServeMetrics",
     "ServeRunner",
     "ServingEngine",
@@ -80,6 +99,11 @@ __all__ = [
     "SwapError",
     "SwapInProgress",
     "SwapRolledBack",
+    "TenantOverBudget",
+    "TenantPolicy",
+    "TenantTable",
     "UnknownModel",
+    "UnknownTenant",
     "VersionState",
+    "WeightedFairScheduler",
 ]

@@ -4,7 +4,17 @@ Requests arrive one at a time; the device wants full fixed-shape batches.
 Every request carries an SLO lane — ``"interactive"`` or ``"bulk"`` —
 and the batcher holds a bounded per-(model, bucket, lane) queue.
 
-Release policy, in priority order:
+With tenancy on (ISSUE 16) queues are further keyed by the request's
+``tenant`` tag and a :class:`~mx_rcnn_tpu.serve.tenancy.
+WeightedFairScheduler` picks WHICH tenant releases the next device batch
+(deficit credits → long-run service in weight proportion); the lane
+policy below then applies within that tenant's groups, so lane
+semantics are preserved inside each tenant's share.  Untagged traffic
+(``tenant=None``) is one more tenant at weight 1; without a scheduler
+the tenant dimension degenerates to a single key and behavior is
+byte-identical to the pre-tenancy batcher.
+
+Release policy (within the picked tenant), in priority order:
 
 1. **bulk-aging guard** — when the bulk head has waited
    ``bulk_age_limit`` seconds AND the bulk lane has not released a batch
@@ -99,6 +109,7 @@ class Request:
     digest: Optional[str] = None         # raw-input identity (containment)
     budget: Optional[object] = None      # quarantine.RetryBudget (engine-set)
     solo: bool = False                   # engine resubmit: release as batch-of-1
+    tenant: Optional[str] = None         # fair-share identity (None = untagged)
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -121,6 +132,7 @@ class DynamicBatcher:
         interactive_linger: float = 0.0,
         bulk_age_limit: float = 2.0,
         on_expired: Optional[Callable[[Request, float], None]] = None,
+        fair=None,
     ):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -132,7 +144,12 @@ class DynamicBatcher:
         # engine hook: resolves a swept request's future + its metrics;
         # when unset the sweep resolves the future itself
         self.on_expired = on_expired
-        # keyed (model, bucket, lane): a batch is homogeneous in ALL three
+        # tenancy.WeightedFairScheduler (or None): picks which tenant
+        # releases next; all its state is mutated under self._cond only
+        self.fair = fair
+        # keyed (model, bucket, lane, tenant): a batch is homogeneous in
+        # all FOUR — tenant-pure batches are what make per-tenant service
+        # attributable, and the tenant tag never reaches a jit signature
         self._queues: Dict[Tuple, deque] = {}
         self._count = 0
         self._closed = False
@@ -143,6 +160,7 @@ class DynamicBatcher:
         self.aged_releases = 0      # bulk released via the aging guard
         self.expired_swept = 0      # dead requests removed pre-pickup
         self.released = {lane: 0 for lane in LANES}  # batches per lane
+        self.released_by_tenant: Dict[Optional[str], int] = {}  # requests
 
     # ------------------------------------------------------------- producers
     def submit(self, req: Request) -> None:
@@ -169,7 +187,7 @@ class DynamicBatcher:
             if req.lane not in LANES:
                 raise ValueError(f"unknown SLO lane {req.lane!r}")
             self._queues.setdefault(
-                (req.model, req.bucket, req.lane), deque()
+                (req.model, req.bucket, req.lane, req.tenant), deque()
             ).append(req)
             self._count += 1
             self._cond.notify()
@@ -177,6 +195,16 @@ class DynamicBatcher:
     def pending(self) -> int:
         with self._cond:
             return self._count
+
+    def queued_by_tenant(self) -> Dict[Optional[str], int]:
+        """Queued request count per tenant — the engine's shed-first
+        predicate reads this under pressure."""
+        with self._cond:
+            out: Dict[Optional[str], int] = {}
+            for key, q in self._queues.items():
+                if q:
+                    out[key[3]] = out.get(key[3], 0) + len(q)
+            return out
 
     def close(self) -> None:
         """Stop accepting; wake the consumer so it can drain and exit."""
@@ -194,14 +222,35 @@ class DynamicBatcher:
             cut = min(cut, head.deadline)
         return cut
 
+    def _active_tenants(self) -> List[Optional[str]]:
+        # caller holds self._cond
+        seen: List[Optional[str]] = []
+        for key, q in self._queues.items():
+            if q and key[3] not in seen:
+                seen.append(key[3])
+        return seen
+
     def _select(self, now: float) -> Optional[Tuple[Tuple, float, Optional[str]]]:
-        """Lane-policy pick: (key, release_at, flag) for the group to
-        serve next, or None when empty.  ``flag`` is "aged" when the
-        bulk-aging guard fired, "preempt" when interactive jumped a
-        waiting bulk head, else None."""
+        """Tenant-then-lane pick: (key, release_at, flag) for the group
+        to serve next, or None when empty.  With a fair scheduler and
+        more than one active tenant, the scheduler picks WHICH tenant
+        gets the slot (pure pick — lingering re-selects don't skew
+        credits) and the lane policy below runs over that tenant's
+        groups only; otherwise it runs over everything.  ``flag`` is
+        "aged" when the bulk-aging guard fired, "preempt" when
+        interactive jumped a waiting bulk head, else None."""
+        filtered = False
+        tenant_filter = None
+        if self.fair is not None:
+            active = self._active_tenants()
+            if len(active) > 1:
+                tenant_filter = self.fair.pick(active)
+                filtered = True
         oldest = {lane: None for lane in LANES}  # lane → (enqueue_t, key)
         for key, q in self._queues.items():
             if not q:
+                continue
+            if filtered and key[3] != tenant_filter:
                 continue
             t = q[0].enqueue_t
             lane = key[2]
@@ -296,6 +345,14 @@ class DynamicBatcher:
                     elif flag == "preempt":
                         self.preemptions += 1
                     self.released[key[2]] += 1
+                    self.released_by_tenant[key[3]] = (
+                        self.released_by_tenant.get(key[3], 0) + n
+                    )
+                    if self.fair is not None:
+                        # the one fairness-state mutation per release:
+                        # cost = requests served, credit spread over the
+                        # tenants that still had queued work
+                        self.fair.charge(key[3], n, self._active_tenants())
                     if key[2] == "bulk":
                         self._last_bulk_release = now
                     # the released group's own expiry is pickup-checked by
@@ -313,9 +370,16 @@ class DynamicBatcher:
     # ---------------------------------------------------------- reporting
     def stats(self) -> Dict:
         with self._cond:
-            return {
+            out = {
                 "preemptions": self.preemptions,
                 "aged_releases": self.aged_releases,
                 "expired_swept": self.expired_swept,
                 "batches_by_lane": dict(self.released),
             }
+            if self.released_by_tenant:
+                out["released_by_tenant"] = {
+                    str(t): n for t, n in self.released_by_tenant.items()
+                }
+            if self.fair is not None:
+                out["fair"] = self.fair.snapshot()
+            return out

@@ -100,13 +100,27 @@ class ServingEngine:
         bulk_age_limit: float = 2.0,
         response_cache=None,
         retry_budget: int = 8,
+        tenants=None,
+        shed_fraction: float = 0.75,
     ):
         self.runner = runner
+        # multi-tenant front door (ISSUE 16): a TenantTable turns on
+        # token-bucket admission at submit, weighted-fair release in the
+        # batcher, shed-over-budget-tenant-first under pressure, and the
+        # per-tenant metrics partition
+        self.tenants = tenants
+        self.shed_fraction = float(shed_fraction)
+        fair = None
+        if tenants is not None:
+            from mx_rcnn_tpu.serve.tenancy import WeightedFairScheduler
+
+            fair = WeightedFairScheduler(weight_fn=tenants.weight)
         self.batcher = DynamicBatcher(
             runner.max_batch, max_linger=max_linger, max_queue=max_queue,
             interactive_linger=interactive_linger,
             bulk_age_limit=bulk_age_limit,
             on_expired=self._expire_swept,
+            fair=fair,
         )
         # idempotent response cache (serve/respcache.py), keyed by image
         # digest per (model, live version); the registry's live-pointer
@@ -132,6 +146,9 @@ class ServingEngine:
         self._quarantine = getattr(runner, "quarantine", None)
         self._retry_budget = max(1, int(retry_budget))
         self._aborting = False
+        # elastic capacity (ISSUE 16): a background AutoScaler attached
+        # via attach_autoscaler; stop() joins it BEFORE pool teardown
+        self.autoscaler = None
         # every not-yet-resolved request, so stop() can sweep leftovers
         # with a terminal EngineStopped instead of stranding submitters
         self._live: Dict[int, Request] = {}
@@ -166,12 +183,19 @@ class ServingEngine:
         Swap interlock (ISSUE 7): any in-flight background model swap is
         cancelled FIRST, waiting for its controller thread to exit — so
         no orphaned warmup thread survives the engine and no swap-side
-        ``device_put`` runs after stop returns."""
+        ``device_put`` runs after stop returns.
+
+        Autoscaler interlock (ISSUE 16, same pattern): the controller
+        thread is stopped and JOINED before pool teardown — a stop
+        racing a scale-up must not leave an orphaned controller minting
+        replicas (and device placements) into a pool being closed."""
         if not self._started:
             return
         reg = getattr(self.runner, "registry", None)
         if reg is not None:
             reg.cancel_swaps(wait=True)
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if not drain:
             self._aborting = True
         self.batcher.close()
@@ -192,6 +216,26 @@ class ServingEngine:
             except InvalidStateError:
                 continue
             self.metrics.inc("stopped")
+
+    def attach_autoscaler(self, policy=None, signal_fn=None, start=True):
+        """Create (and by default start) an
+        :class:`~mx_rcnn_tpu.serve.autoscaler.AutoScaler` bound to this
+        engine's replica pool.  Requires a routed runner.  The engine
+        owns its lifecycle from here: ``stop()`` joins the controller
+        before tearing the pool down."""
+        if not self._routed:
+            raise RuntimeError(
+                "autoscaling needs a ReplicaPool runner — single-runner "
+                "engines have nothing to scale"
+            )
+        from mx_rcnn_tpu.serve.autoscaler import AutoScaler
+
+        self.autoscaler = AutoScaler(
+            self.runner, policy=policy, engine=self, signal_fn=signal_fn
+        )
+        if start:
+            self.autoscaler.start()
+        return self.autoscaler
 
     def __enter__(self) -> "ServingEngine":
         return self.start()
@@ -230,22 +274,43 @@ class ServingEngine:
         deadline_s: Optional[float] = None,
         model: Optional[str] = None,
         lane: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> Future:
         """Enqueue one image; returns a Future resolving to the
         per-class detections list.  ``model`` selects a registry family
         (None = the default model — the tenancy request schema);
         ``lane`` tags the SLO class (``"interactive"`` | ``"bulk"``,
-        None = the model's registry default).  Raises
+        None = the model's registry default); ``tenant`` is the fair-
+        share identity (None = untagged in-process caller).  Raises
         :class:`~mx_rcnn_tpu.serve.quarantine.InvalidRequest` (failed
         the admission gate),
         :class:`~mx_rcnn_tpu.serve.quarantine.PoisonRequest` (digest is
         quarantined),
+        :class:`~mx_rcnn_tpu.serve.tenancy.UnknownTenant` /
+        :class:`~mx_rcnn_tpu.serve.tenancy.TenantOverBudget` (tenant
+        admission, with a TenantTable configured),
         :class:`~mx_rcnn_tpu.serve.buckets.BucketOverflow` (oversize),
         :class:`~mx_rcnn_tpu.serve.batcher.QueueFull` (backpressure), or
         :class:`~mx_rcnn_tpu.serve.registry.UnknownModel` synchronously
         — all count as ``rejected``."""
         if not self._started:
             raise RuntimeError("engine not started")
+        if self.tenants is not None:
+            # tenant admission BEFORE any image work: an unknown tenant
+            # or an empty token bucket must cost nothing but this check
+            # (the quarantine fast-fail discipline, applied per tenant)
+            from mx_rcnn_tpu.serve.tenancy import TenantOverBudget
+
+            try:
+                self.tenants.admit(tenant)
+            except TenantOverBudget:
+                self.metrics.inc("over_budget")
+                self.metrics.inc("rejected")
+                self.metrics.record_tenant(tenant, rejected=True)
+                raise
+            except Exception:
+                self.metrics.inc("rejected")
+                raise
         reg = getattr(self.runner, "registry", None)
         if model is not None:
             if reg is not None and not reg.has(model):
@@ -305,9 +370,11 @@ class ServingEngine:
                     e2e = time.monotonic() - t0
                     self.metrics.e2e.record(e2e)
                     self.metrics.record_lane(lane, e2e_s=e2e)
+                    self.metrics.record_tenant(tenant, e2e_s=e2e)
                     if model is not None:
                         self.metrics.record_model(model, e2e)
                     return f
+        cap = self.batcher.max_queue
         if self._routed:
             # load shedding: scale the effective intake capacity by the
             # pool's healthy fraction — when half the replicas are out,
@@ -318,10 +385,34 @@ class ServingEngine:
             if frac == 0.0 or self.batcher.pending() >= cap:
                 self.metrics.inc("shed")
                 self.metrics.inc("rejected")
+                if tenant is not None:
+                    self.metrics.record_tenant(tenant, shed=True)
                 raise QueueFull(
                     f"shedding load: healthy fraction {frac:.2f}, "
                     f"effective queue capacity {cap if frac else 0}"
                 )
+        if self.tenants is not None and tenant is not None:
+            # shed the over-budget tenant FIRST: past the pressure
+            # threshold, a tenant already holding more than its weight
+            # share of the backlog is rejected while under-share tenants
+            # keep landing until the hard cap — overload cost falls on
+            # whoever caused it
+            pending = self.batcher.pending()
+            if pending >= self.shed_fraction * cap:
+                by_t = self.batcher.queued_by_tenant()
+                if self.tenants.over_share(tenant, by_t):
+                    from mx_rcnn_tpu.serve.tenancy import TenantOverBudget
+
+                    self.tenants.note_shed(tenant)
+                    self.metrics.inc("tenant_shed")
+                    self.metrics.inc("shed")
+                    self.metrics.inc("rejected")
+                    self.metrics.record_tenant(tenant, shed=True)
+                    raise TenantOverBudget(
+                        f"shedding tenant {tenant!r}: holds "
+                        f"{by_t.get(tenant, 0)}/{pending} queued requests, "
+                        f"over its fair share under pressure"
+                    )
         deadline = (
             time.monotonic() + deadline_s if deadline_s is not None else None
         )
@@ -335,6 +426,7 @@ class ServingEngine:
                     im, deadline=deadline, model=model
                 )
             req.lane = lane
+            req.tenant = tenant
             req.cache_key = cache_key
             if digest is not None:
                 req.digest = digest
@@ -362,6 +454,7 @@ class ServingEngine:
         callees only take leaf locks."""
         self.metrics.inc("expired")
         self.metrics.record_lane(req.lane, expired=True)
+        self.metrics.record_tenant(req.tenant, expired=True)
         self._resolve(
             req,
             exc=DeadlineExceeded(
@@ -403,6 +496,7 @@ class ServingEngine:
                 if r.expired(now):
                     self.metrics.inc("expired")
                     self.metrics.record_lane(r.lane, expired=True)
+                    self.metrics.record_tenant(r.tenant, expired=True)
                     self._resolve(
                         r,
                         exc=DeadlineExceeded(
@@ -472,6 +566,7 @@ class ServingEngine:
             if r.expired():
                 self.metrics.inc("expired")
                 self.metrics.record_lane(r.lane, expired=True)
+                self.metrics.record_tenant(r.tenant, expired=True)
                 self._resolve(
                     r,
                     exc=DeadlineExceeded(
@@ -488,6 +583,7 @@ class ServingEngine:
                 if model is not None:
                     self.metrics.record_model(model, ok=False)
                 self.metrics.record_lane(r.lane, ok=False)
+                self.metrics.record_tenant(r.tenant, ok=False)
                 self._resolve(r, exc=e)
                 continue
             if r.cache_key is not None and self.response_cache is not None:
@@ -509,6 +605,9 @@ class ServingEngine:
             self.metrics.record_lane(
                 r.lane, e2e_s, queue_wait_s=r.picked_t - r.enqueue_t
             )
+            self.metrics.record_tenant(
+                r.tenant, e2e_s, queue_wait_s=r.picked_t - r.enqueue_t
+            )
             self._resolve(r, dets)
 
     # -------------------------------------------------- containment triage
@@ -518,6 +617,7 @@ class ServingEngine:
         if req.model is not None:
             self.metrics.record_model(req.model, ok=False)
         self.metrics.record_lane(req.lane, ok=False)
+        self.metrics.record_tenant(req.tenant, ok=False)
         self._resolve(req, exc=exc)
 
     def _settle_failed(self, reqs: List[Request],
@@ -627,6 +727,10 @@ class ServingEngine:
             out["pool"] = self.runner.snapshot()
         if self._quarantine is not None:
             out["quarantine"] = self._quarantine.snapshot()
+        if self.tenants is not None:
+            out["tenancy"] = self.tenants.snapshot()
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.snapshot()
         reg = getattr(self.runner, "registry", None)
         if reg is not None:
             out["registry"] = reg.snapshot()

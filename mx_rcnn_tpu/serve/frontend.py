@@ -1,0 +1,430 @@
+"""Wire-facing front door: length-prefixed socket protocol over submit.
+
+The engine's in-process ``submit`` trusts its caller; real multi-tenant
+traffic arrives over a wire and must be authenticated, typed, and
+bounded BEFORE it can cost anything.  :class:`Frontend` is that intake:
+a minimal length-prefixed protocol (no external deps) where every
+request carries ``tenant``, ``lane``, and ``deadline``, and every
+rejection is a TYPED error frame from a closed taxonomy — the client
+can tell "back off" (``over_budget``, ``queue_full``) from "fix your
+request" (``invalid_frame``, ``invalid_request``) from "you are not
+provisioned" (``unknown_tenant``).
+
+Wire format (all integers big-endian):
+
+* request frame: ``u32 length`` + payload, where payload is one JSON
+  header line (UTF-8, ``\\n``-terminated) followed by raw image bytes::
+
+      {"tenant": "acme", "lane": "interactive", "deadline_ms": 250,
+       "model": null, "dtype": "uint8", "shape": [480, 640, 3]}\\n
+      <H*W*3 raw bytes>
+
+* response frame: ``u32 length`` + one JSON object::
+
+      {"ok": true, "detections": [null, [[x1,y1,x2,y2,score], ...], ...]}
+      {"ok": false, "error": "<code>", "message": "..."}
+
+Error codes: ``invalid_frame`` (length/JSON/shape/byte-count violations
+— rejected before an array is even built), ``unknown_tenant``,
+``over_budget``, ``invalid_request`` (failed the quarantine admission
+gate), ``poison`` (quarantined digest), ``queue_full``, ``deadline``,
+``unknown_model``, ``exhausted``, ``engine_stopped``, ``error``.
+
+The frame parser enforces byte-level bounds (``max_frame`` caps payload
+size so a hostile length prefix cannot balloon memory), then the decoded
+array flows through the SAME admission matrix as in-process callers:
+``engine.submit`` runs ``quarantine.validate_image``, the tenant token
+bucket, and the shed logic — nothing reaches the batcher that an
+in-process caller could not have submitted.  (The structural
+``quarantine.validate_request`` gate fires once more inside
+``batcher.submit``, unchanged.)
+
+One handler thread per connection (requests on one connection are
+served in order, connections are independent); the accept loop and all
+handlers join on ``stop()``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+
+__all__ = ["Frontend", "FrontendClient", "WIRE_DTYPES"]
+
+#: dtypes a frame may declare; anything else is an invalid_frame (the
+#: admission gate would reject non-numeric dtypes anyway — rejecting at
+#: parse time just refuses to build the array at all)
+WIRE_DTYPES = {"uint8": np.uint8, "float32": np.float32}
+
+_LEN = struct.Struct(">I")
+
+
+def _classify(e: BaseException) -> str:
+    """Exception → wire error code (same name-based convention as
+    ``loadgen.classify`` so the two taxonomies cannot drift apart)."""
+    name = type(e).__name__
+    if "UnknownTenant" in name:
+        return "unknown_tenant"
+    if "OverBudget" in name:
+        return "over_budget"
+    if "UnknownModel" in name:
+        return "unknown_model"
+    if "InvalidRequest" in name:
+        return "invalid_request"
+    if "Poison" in name:
+        return "poison"
+    if "QueueFull" in name:
+        return "queue_full"
+    if "BucketOverflow" in name:
+        return "invalid_request"
+    if "Exhausted" in name:
+        return "exhausted"
+    if "Deadline" in name:
+        return "deadline"
+    if "EngineStopped" in name:
+        return "engine_stopped"
+    return "error"
+
+
+class _FrameError(ValueError):
+    """Malformed frame — rejected at the parser, before any array is
+    built or any admission code runs."""
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes or None on clean EOF; raises on a
+    connection torn mid-frame."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            if not buf:
+                return None
+            raise ConnectionError(
+                f"connection closed mid-frame ({len(buf)}/{n} bytes)"
+            )
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _parse_frame(payload: bytes) -> Tuple[Dict, np.ndarray]:
+    """Payload → (header dict, image array); raises :class:`_FrameError`
+    on every malformation (missing header terminator, bad JSON, missing
+    or non-string tenant, undeclared dtype, bad shape, byte-count
+    mismatch)."""
+    nl = payload.find(b"\n")
+    if nl < 0:
+        raise _FrameError("no header line in frame")
+    try:
+        header = json.loads(payload[:nl].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise _FrameError(f"header is not valid JSON: {e}")
+    if not isinstance(header, dict):
+        raise _FrameError(f"header must be a JSON object, got "
+                          f"{type(header).__name__}")
+    tenant = header.get("tenant")
+    if not isinstance(tenant, str) or not tenant:
+        raise _FrameError("frame must carry a non-empty string 'tenant'")
+    dtype_s = header.get("dtype", "uint8")
+    if dtype_s not in WIRE_DTYPES:
+        raise _FrameError(
+            f"dtype {dtype_s!r} not in {sorted(WIRE_DTYPES)}"
+        )
+    shape = header.get("shape")
+    if (
+        not isinstance(shape, (list, tuple)) or len(shape) != 3
+        or not all(isinstance(d, int) and d > 0 for d in shape)
+        or shape[2] != 3
+    ):
+        raise _FrameError(f"shape must be [H, W, 3] positive ints, "
+                          f"got {shape!r}")
+    dtype = WIRE_DTYPES[dtype_s]
+    body = payload[nl + 1:]
+    expected = int(np.prod(shape)) * np.dtype(dtype).itemsize
+    if len(body) != expected:
+        raise _FrameError(
+            f"image bytes {len(body)} != shape/dtype implied {expected}"
+        )
+    im = np.frombuffer(body, dtype=dtype).reshape(shape)
+    return header, im
+
+
+def _encode_detections(dets) -> List:
+    """Per-class detections → JSON-safe nested lists (None stays null,
+    float32 rounds through Python floats)."""
+    out = []
+    for cls in dets:
+        if cls is None:
+            out.append(None)
+        else:
+            out.append(np.asarray(cls).tolist())
+    return out
+
+
+class Frontend:
+    """Socket intake bound to one :class:`ServingEngine`.
+
+    ``port=0`` binds an ephemeral port (tests); read ``.port`` after
+    ``start()``.  Counters: ``accepted`` connections, ``frames`` parsed,
+    ``rejected_frames`` (malformed at the wire), ``errors`` by code.
+    """
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
+                 max_frame: int = 64 * 1024 * 1024, backlog: int = 16):
+        self.engine = engine
+        self.host = host
+        self.port = int(port)
+        self.max_frame = int(max_frame)
+        self.backlog = int(backlog)
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self._lock = make_lock("Frontend._lock")
+        self._conns: Dict[int, socket.socket] = {}
+        self._handlers: List[threading.Thread] = []
+        self._next_conn = 0
+        self.accepted = 0
+        self.frames = 0
+        self.rejected_frames = 0
+        self.errors: Dict[str, int] = {}
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "Frontend":
+        if self._sock is not None:
+            return self
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(self.backlog)
+        self.port = s.getsockname()[1]
+        self._sock = s
+        self._stopping = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="frontend-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Close the listener and every live connection; join the accept
+        loop and all handler threads (in-flight requests resolve first —
+        the engine owns their futures, not the sockets)."""
+        self._stopping = True
+        sock = self._sock
+        self._sock = None
+        if sock is not None:
+            # shutdown BEFORE close: closing a listener does not wake a
+            # thread blocked in accept() on Linux — shutdown does
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns.values())
+            handlers = list(self._handlers)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+            self._accept_thread = None
+        for h in handlers:
+            h.join(timeout=5.0)
+
+    def __enter__(self) -> "Frontend":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- server
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            sock = self._sock
+            if sock is None:
+                return
+            try:
+                conn, _addr = sock.accept()
+            except OSError:
+                return  # listener closed by stop()
+            with self._lock:
+                cid = self._next_conn
+                self._next_conn += 1
+                self._conns[cid] = conn
+                self.accepted += 1
+                # prune finished handlers so a long-lived server's
+                # bookkeeping stays bounded by live connections
+                self._handlers = [t for t in self._handlers if t.is_alive()]
+                h = threading.Thread(
+                    target=self._handle, args=(cid, conn),
+                    name=f"frontend-conn-{cid}", daemon=True,
+                )
+                self._handlers.append(h)
+            h.start()
+
+    def _note_error(self, code: str) -> None:
+        with self._lock:
+            self.errors[code] = self.errors.get(code, 0) + 1
+
+    def _send(self, conn: socket.socket, obj: Dict) -> None:
+        data = json.dumps(obj).encode("utf-8")
+        conn.sendall(_LEN.pack(len(data)) + data)
+
+    def _handle(self, cid: int, conn: socket.socket) -> None:
+        try:
+            while not self._stopping:
+                hdr = _read_exact(conn, _LEN.size)
+                if hdr is None:
+                    return  # clean EOF
+                (length,) = _LEN.unpack(hdr)
+                if length == 0 or length > self.max_frame:
+                    # hostile/broken length prefix: typed reject, then
+                    # close — the stream offset can no longer be trusted
+                    with self._lock:
+                        self.rejected_frames += 1
+                    self._note_error("invalid_frame")
+                    self._send(conn, {
+                        "ok": False, "error": "invalid_frame",
+                        "message": f"frame length {length} outside "
+                                   f"(0, {self.max_frame}]",
+                    })
+                    return
+                payload = _read_exact(conn, length)
+                if payload is None:
+                    return
+                with self._lock:
+                    self.frames += 1
+                try:
+                    header, im = _parse_frame(payload)
+                except _FrameError as e:
+                    with self._lock:
+                        self.rejected_frames += 1
+                    self._note_error("invalid_frame")
+                    self._send(conn, {
+                        "ok": False, "error": "invalid_frame",
+                        "message": str(e),
+                    })
+                    continue
+                self._serve_one(conn, header, im)
+        except (ConnectionError, OSError):
+            pass  # peer went away; per-request state lives in the engine
+        finally:
+            with self._lock:
+                self._conns.pop(cid, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_one(self, conn: socket.socket, header: Dict,
+                   im: np.ndarray) -> None:
+        deadline_ms = header.get("deadline_ms")
+        deadline_s = (
+            float(deadline_ms) / 1000.0 if deadline_ms is not None else None
+        )
+        try:
+            fut = self.engine.submit(
+                im,
+                deadline_s=deadline_s,
+                model=header.get("model"),
+                lane=header.get("lane"),
+                tenant=header["tenant"],
+            )
+            dets = fut.result()
+        except Exception as e:  # noqa: BLE001 — typed taxonomy on the wire
+            code = _classify(e)
+            self._note_error(code)
+            self._send(conn, {
+                "ok": False, "error": code, "message": repr(e),
+            })
+            return
+        self._send(conn, {
+            "ok": True, "detections": _encode_detections(dets),
+        })
+
+    # ------------------------------------------------------ observability
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "port": self.port,
+                "accepted": self.accepted,
+                "frames": self.frames,
+                "rejected_frames": self.rejected_frames,
+                "errors": dict(self.errors),
+            }
+
+
+class FrontendClient:
+    """Minimal blocking client for tests/bench: one socket, one request
+    at a time.  ``request`` returns the parsed response dict;
+    ``send_raw`` ships arbitrary bytes (the malformed-frame matrix)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+
+    def request(self, im: np.ndarray, tenant: str,
+                lane: Optional[str] = None,
+                deadline_s: Optional[float] = None,
+                model: Optional[str] = None) -> Dict:
+        im = np.ascontiguousarray(im)
+        dtype_s = {np.dtype(np.uint8): "uint8",
+                   np.dtype(np.float32): "float32"}.get(im.dtype)
+        if dtype_s is None:
+            im = im.astype(np.float32)
+            dtype_s = "float32"
+        header = {
+            "tenant": tenant, "lane": lane, "model": model,
+            "deadline_ms": (
+                deadline_s * 1000.0 if deadline_s is not None else None
+            ),
+            "dtype": dtype_s, "shape": list(im.shape),
+        }
+        payload = json.dumps(header).encode("utf-8") + b"\n" + im.tobytes()
+        self._sock.sendall(_LEN.pack(len(payload)) + payload)
+        return self._recv()
+
+    def send_raw(self, payload: bytes, prefix: bool = True) -> Dict:
+        """Ship ``payload`` (length-prefixed unless ``prefix=False``) and
+        read one response — the malformed-frame test surface."""
+        data = _LEN.pack(len(payload)) + payload if prefix else payload
+        self._sock.sendall(data)
+        return self._recv()
+
+    def _recv(self) -> Dict:
+        hdr = _read_exact(self._sock, _LEN.size)
+        if hdr is None:
+            raise ConnectionError("server closed connection")
+        (length,) = _LEN.unpack(hdr)
+        body = _read_exact(self._sock, length)
+        if body is None:
+            raise ConnectionError("server closed connection mid-response")
+        return json.loads(body.decode("utf-8"))
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "FrontendClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

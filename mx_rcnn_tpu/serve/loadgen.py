@@ -70,6 +70,63 @@ def poison_image(flavor: str, index: int, h: int, w: int,
     raise ValueError(f"unknown poison flavor {flavor!r}")
 
 
+def diurnal_arrivals(
+    num_requests: int,
+    lo_rps: float,
+    hi_rps: float,
+    cycles: float = 1.0,
+    seed: int = 0,
+) -> Tuple[float, ...]:
+    """Trace-driven arrival offsets (seconds from start) following a
+    diurnal ramp: the instantaneous rate sweeps sinusoidally between
+    ``lo_rps`` and ``hi_rps`` over ``cycles`` full periods.  Built by
+    integrating the rate curve and inverse-sampling uniform quantiles —
+    fully deterministic for a given argument tuple (``seed`` only
+    perturbs sub-slot jitter), so two runs replay the identical trace."""
+    if num_requests < 1:
+        return ()
+    rng = np.random.RandomState(seed)
+    # cumulative arrivals at fine time resolution, then invert
+    steps = max(1024, num_requests * 8)
+    # total duration such that the mean rate delivers num_requests
+    mean_rps = (lo_rps + hi_rps) / 2.0
+    duration = num_requests / mean_rps
+    t = np.linspace(0.0, duration, steps)
+    phase = 2.0 * np.pi * cycles * t / duration
+    rate = lo_rps + (hi_rps - lo_rps) * 0.5 * (1.0 - np.cos(phase))
+    cum = np.concatenate([[0.0], np.cumsum(rate[:-1] * np.diff(t))])
+    targets = (np.arange(num_requests) + rng.uniform(0, 1, num_requests)) \
+        * cum[-1] / num_requests
+    offsets = np.interp(targets, cum, t)
+    return tuple(float(x) for x in np.sort(offsets))
+
+
+def flash_arrivals(
+    num_requests: int,
+    base_rps: float,
+    flash_frac: float = 0.5,
+    flash_at: float = 0.5,
+    flash_rps: Optional[float] = None,
+    seed: int = 0,
+) -> Tuple[float, ...]:
+    """Flash-crowd arrival offsets: steady ``base_rps`` background with
+    ``flash_frac`` of all requests compressed into a spike at
+    ``flash_at`` (fraction of the run) arriving at ``flash_rps``
+    (default 10× base).  Deterministic like :func:`diurnal_arrivals`."""
+    if num_requests < 1:
+        return ()
+    rng = np.random.RandomState(seed)
+    n_flash = int(num_requests * flash_frac)
+    n_base = num_requests - n_flash
+    duration = max(n_base, 1) / base_rps
+    base = np.sort(rng.uniform(0.0, duration, n_base))
+    spike_rate = flash_rps if flash_rps is not None else base_rps * 10.0
+    spike_t0 = duration * flash_at
+    spike = spike_t0 + np.sort(rng.uniform(0, 1, n_flash)) \
+        * (n_flash / spike_rate)
+    return tuple(float(x) for x in np.sort(np.concatenate([base, spike])))
+
+
 def run_load(
     engine,
     num_requests: int = 64,
@@ -82,6 +139,9 @@ def run_load(
     models: Optional[Sequence[str]] = None,
     lanes: Optional[Sequence[Optional[str]]] = None,
     poison_mix: Optional[Sequence[Optional[str]]] = None,
+    tenants: Optional[Sequence[Optional[str]]] = None,
+    arrivals: Optional[Sequence[float]] = None,
+    backoff_give_up: Optional[int] = None,
 ) -> Dict:
     """Drive ``engine`` with ``num_requests`` synthetic images; returns a
     report dict (wall/throughput/outcome counts + the engine's metrics
@@ -111,6 +171,26 @@ def run_load(
     lanes so existing scenarios keep their streams.  Per-flavor outcome
     counts land under ``report["poison_outcomes"]``.
 
+    ``tenants`` (optional) draws each request's tenant tag from the
+    sequence (``None`` entries = untagged) — the deterministic
+    multi-tenant client mix (ISSUE 16).  Drawn AFTER poison so existing
+    scenarios keep their streams.  Per-tenant outcome counts land under
+    ``report["tenant_outcomes"]`` mirroring ``lane_outcomes``, with the
+    ``over_budget``/``shed`` rejections attributable per tenant.
+
+    ``arrivals`` (optional) switches the driver from closed-loop to
+    trace-driven: entry ``i`` is request ``i``'s offset in seconds from
+    load start (see :func:`diurnal_arrivals` / :func:`flash_arrivals`),
+    and a client thread holding request ``i`` sleeps until that offset
+    before submitting.  A client behind schedule submits immediately, so
+    the trace is an arrival-time floor — exactly the open-loop shape an
+    autoscaler must chase.
+
+    ``backoff_give_up`` (optional) bounds QueueFull/over-budget retries
+    per request: after that many rejections the request resolves as its
+    last rejection kind instead of retrying forever — shed traffic must
+    be COUNTABLE for the fairness bench, not retried into admission.
+
     ``collect=True`` additionally stores each request's resolution under
     ``report["_results"]`` — ``{index: ("ok", detections) | (kind, repr)}``
     — which is what lets a faulted run be compared byte-for-byte against
@@ -138,12 +218,19 @@ def run_load(
          for _ in range(num_requests)]
         if poison_mix else None
     )
+    req_tenants = (
+        [tenants[size_rng.randint(len(tenants))]
+         for _ in range(num_requests)]
+        if tenants else None
+    )
     counter = iter(range(num_requests))
     lock = threading.Lock()
     outcomes = {"ok": 0, "deadline": 0, "error": 0, "queue_full_retries": 0,
-                "invalid": 0, "poison": 0, "exhausted": 0}
+                "invalid": 0, "poison": 0, "exhausted": 0,
+                "over_budget": 0, "queue_full": 0}
     lane_outcomes: Dict[str, Dict[str, int]] = {}
     poison_outcomes: Dict[str, Dict[str, int]] = {}
+    tenant_outcomes: Dict[str, Dict[str, int]] = {}
     results: Dict[int, Tuple[str, object]] = {}
     times: Dict[int, Tuple[float, float]] = {}
 
@@ -151,6 +238,10 @@ def run_load(
         name = type(e).__name__
         if "InvalidRequest" in name:
             return "invalid"
+        if "OverBudget" in name:
+            return "over_budget"
+        if "QueueFull" in name:
+            return "queue_full"
         if "Poison" in name:
             return "poison"
         if "Exhausted" in name:
@@ -158,7 +249,8 @@ def run_load(
         return "deadline" if "Deadline" in name else "error"
 
     def note(key: str, lane: Optional[str] = None,
-             flavor: Optional[str] = None) -> None:
+             flavor: Optional[str] = None,
+             tenant: Optional[str] = None) -> None:
         with lock:
             outcomes[key] += 1
             if lane is not None:
@@ -170,8 +262,11 @@ def run_load(
             if flavor is not None:
                 pf = poison_outcomes.setdefault(flavor, {})
                 pf[key] = pf.get(key, 0) + 1
+            if tenant is not None:
+                pt = tenant_outcomes.setdefault(tenant, {})
+                pt[key] = pt.get(key, 0) + 1
 
-    def client() -> None:
+    def client(t_start: float) -> None:
         while True:
             with lock:
                 i = next(counter, None)
@@ -190,20 +285,39 @@ def run_load(
             lane = req_lanes[i] if req_lanes is not None else None
             if lane is not None:
                 mkw["lane"] = lane
+            tenant = req_tenants[i] if req_tenants is not None else None
+            if tenant is not None:
+                mkw["tenant"] = tenant
+            if arrivals is not None:
+                # trace-driven: hold request i until its scheduled
+                # arrival offset (behind schedule = submit immediately)
+                wait = t_start + arrivals[i] - time.monotonic()
+                if wait > 0:
+                    time.sleep(wait)
             t_submit = time.monotonic()
             fut = None
+            retries = 0
             while True:
                 try:
                     fut = engine.submit(im, deadline_s=deadline_s, **mkw)
                     break
-                except QueueFull:
+                except QueueFull as e:
+                    retries += 1
+                    if backoff_give_up is not None \
+                            and retries >= backoff_give_up:
+                        note("queue_full", lane, flavor, tenant)
+                        if collect:
+                            with lock:
+                                results[i] = ("queue_full", repr(e))
+                        break
                     note("queue_full_retries")
                     time.sleep(queue_full_backoff)
                 except Exception as e:
-                    # synchronous reject: admission gate (InvalidRequest)
-                    # or quarantine fast-fail (PoisonRequest)
+                    # synchronous reject: admission gate (InvalidRequest),
+                    # quarantine fast-fail (PoisonRequest), or tenant
+                    # admission (UnknownTenant / TenantOverBudget)
                     kind = classify(e)
-                    note(kind, lane, flavor)
+                    note(kind, lane, flavor, tenant)
                     if collect:
                         with lock:
                             results[i] = (kind, repr(e))
@@ -211,13 +325,13 @@ def run_load(
             if fut is not None:
                 try:
                     dets = fut.result()
-                    note("ok", lane, flavor)
+                    note("ok", lane, flavor, tenant)
                     if collect:
                         with lock:
                             results[i] = ("ok", dets)
                 except Exception as e:
                     kind = classify(e)
-                    note(kind, lane, flavor)
+                    note(kind, lane, flavor, tenant)
                     if collect:
                         with lock:
                             results[i] = (kind, repr(e))
@@ -225,11 +339,12 @@ def run_load(
                 with lock:
                     times[i] = (t_submit, time.monotonic())
 
+    t0 = time.monotonic()
     threads = [
-        threading.Thread(target=client, name=f"loadgen-{t}", daemon=True)
+        threading.Thread(target=client, args=(t0,), name=f"loadgen-{t}",
+                         daemon=True)
         for t in range(max(1, concurrency))
     ]
-    t0 = time.monotonic()
     for t in threads:
         t.start()
     for t in threads:
@@ -258,6 +373,14 @@ def run_load(
             [req_poison[i] for i in range(num_requests)]
         )
         report["poison_outcomes"] = poison_outcomes
+    if tenants:
+        report["tenants"] = list(tenants)
+        report["tenant_outcomes"] = tenant_outcomes
+    if arrivals is not None:
+        report["trace"] = {
+            "arrivals": len(arrivals),
+            "span_s": round(float(arrivals[-1]), 4) if len(arrivals) else 0.0,
+        }
     if collect:
         report["_results"] = results
         report["_times"] = times

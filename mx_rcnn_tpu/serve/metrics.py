@@ -223,6 +223,9 @@ class ServeMetrics:
         self.retried = 0       # batch re-executions via RetryPolicy
         self.shed = 0          # rejected early on low healthy fraction
         self.stopped = 0       # resolved EngineStopped at teardown
+        # tenant-fair front door (ISSUE 16)
+        self.over_budget = 0   # token-bucket rejections (TenantOverBudget)
+        self.tenant_shed = 0   # over-share tenant shed under pressure
         # query-of-death containment stages (ISSUE 12)
         self.invalid = 0       # rejected at the admission gate
         self.poisoned = 0      # failed fast on a quarantined digest
@@ -244,6 +247,10 @@ class ServeMetrics:
         # one lane ("bulk" when untagged), so lane histograms partition
         # the aggregate ones above
         self.by_lane: Dict[str, Dict] = {}
+        # per-tenant breakdown (ISSUE 16): populated only for requests
+        # that carried a tenant tag — the fairness-isolation evidence
+        # (an aggressor's shed storm must not move the victim histogram)
+        self.by_tenant: Dict[str, Dict] = {}
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -293,6 +300,47 @@ class ServeMetrics:
             if queue_wait_s is not None:
                 m["queue_wait"].record(queue_wait_s)
 
+    def _tenant(self, tenant: str) -> Dict:
+        # caller holds self._lock
+        m = self.by_tenant.get(tenant)
+        if m is None:
+            m = self.by_tenant[tenant] = {
+                "completed": 0, "failed": 0, "expired": 0,
+                "shed": 0, "rejected": 0,
+                "queue_wait": LatencyHistogram(), "e2e": LatencyHistogram(),
+            }
+        return m
+
+    def record_tenant(self, tenant: Optional[str],
+                      e2e_s: Optional[float] = None,
+                      queue_wait_s: Optional[float] = None,
+                      ok: bool = True, expired: bool = False,
+                      shed: bool = False, rejected: bool = False) -> None:
+        """Per-tenant counters + latency histograms — same partition
+        shape as :meth:`record_lane` so the fairness bench can hold one
+        tenant's p99 against another's shed count.  No-op for untagged
+        requests (``tenant=None``): the single-tenant deployment pays
+        and reports nothing extra."""
+        if tenant is None:
+            return
+        with self._lock:
+            m = self._tenant(tenant)
+            if shed:
+                m["shed"] += 1
+                return
+            if rejected:
+                m["rejected"] += 1
+                return
+            if expired:
+                m["expired"] += 1
+            else:
+                m["completed" if ok else "failed"] += 1
+        if ok and not expired:
+            if e2e_s is not None:
+                m["e2e"].record(e2e_s)
+            if queue_wait_s is not None:
+                m["queue_wait"].record(queue_wait_s)
+
     def record_lane_batch(self, lane: str, real: int, slots: int) -> None:
         with self._lock:
             m = self._lane(lane)
@@ -332,6 +380,8 @@ class ServeMetrics:
                     "retried": self.retried,
                     "shed": self.shed,
                     "stopped": self.stopped,
+                    "over_budget": self.over_budget,
+                    "tenant_shed": self.tenant_shed,
                     "invalid": self.invalid,
                     "poisoned": self.poisoned,
                     "exhausted": self.exhausted,
@@ -360,6 +410,7 @@ class ServeMetrics:
         with self._lock:
             by_model = dict(self.by_model)
             by_lane = dict(self.by_lane)
+            by_tenant = dict(self.by_tenant)
         if by_model:
             out["models"] = {
                 mid: {
@@ -384,6 +435,19 @@ class ServeMetrics:
                     "e2e": m["e2e"].snapshot(),
                 }
                 for lane, m in by_lane.items()
+            }
+        if by_tenant:
+            out["tenants"] = {
+                t: {
+                    "completed": m["completed"],
+                    "failed": m["failed"],
+                    "expired": m["expired"],
+                    "shed": m["shed"],
+                    "rejected": m["rejected"],
+                    "queue_wait": m["queue_wait"].snapshot(),
+                    "e2e": m["e2e"].snapshot(),
+                }
+                for t, m in by_tenant.items()
             }
         if compile_cache is not None:
             out["compile"] = compile_cache.snapshot()

@@ -141,6 +141,13 @@ class ReplicaPool:
         # requeue unboundedly); the engine detects the table and turns
         # on digests + retry budgets.
         self.quarantine = quarantine
+        # elastic membership (ISSUE 16): the factory is kept so the
+        # autoscaler can mint replicas after construction; the replicas
+        # list is COPY-ON-WRITE — add/remove swap in a new list under
+        # the pool lock, so `_pick`/snapshot readers iterate a stable
+        # list without taking it
+        self._factory = runner_factory
+        self._next_index = n_replicas
         self.replicas: List[Replica] = [
             Replica(i, runner_factory, policy=self.policy,
                     quarantine=quarantine,
@@ -274,8 +281,9 @@ class ReplicaPool:
 
     # ------------------------------------------------------- routing
     def healthy_fraction(self) -> float:
-        n = sum(1 for r in self.replicas if r.routable)
-        return n / len(self.replicas)
+        replicas = self.replicas  # one stable copy-on-write read
+        n = sum(1 for r in replicas if r.routable)
+        return n / len(replicas)
 
     def _pick(
         self,
@@ -287,10 +295,11 @@ class ReplicaPool:
         # bucket keeps hitting the same replica, so multi-tenancy does
         # not spread every family's signatures across the whole pool
         affinity = hash((model, bucket))
-        n = len(self.replicas)
+        replicas = self.replicas  # one stable copy-on-write read
+        n = len(replicas)
         best = None
         best_key = None
-        for r in self.replicas:
+        for r in replicas:
             if r.index in exclude or not r.routable:
                 continue
             key = (r.load(), (r.index - affinity) % n)
@@ -488,6 +497,46 @@ class ReplicaPool:
         with self._lock:
             self.completed += 1
         self.service.record(time.monotonic() - t0)
+
+    # ------------------------------------------- elastic membership
+    def add_replica(self) -> Replica:
+        """Grow the pool by one replica (autoscaler scale-up).  The new
+        replica warms on its own worker thread (WARMING → HEALTHY) and
+        takes traffic only once routable; construction happens OUTSIDE
+        the pool lock — only the list swap holds it."""
+        with self._lock:
+            index = self._next_index
+            self._next_index += 1
+        r = Replica(index, self._factory, policy=self.policy,
+                    quarantine=self.quarantine,
+                    inflight_depth=self.inflight_depth)
+        with self._lock:
+            self.replicas = self.replicas + [r]
+        return r
+
+    def remove_replica(self, replica: Optional[Replica] = None,
+                       timeout: float = 5.0) -> Optional[Replica]:
+        """Shrink the pool by one replica (autoscaler scale-down); None
+        when the pool is already at one replica.  Default victim is the
+        YOUNGEST replica (replica 0 anchors the host-side ``_ref``
+        facade and is never removed).  The victim leaves the routing set
+        first — no new dispatches land on it — then ``stop`` trips it,
+        failing its queued and in-flight dispatches with
+        ``ReplicaDrained``, which the ``run`` loop requeues on siblings:
+        zero requests are lost through a shrink by construction."""
+        with self._lock:
+            if len(self.replicas) <= 1:
+                return None
+            victim = replica if replica is not None else self.replicas[-1]
+            if victim is self.replicas[0]:
+                return None
+            if victim not in self.replicas:
+                return None
+            self.replicas = [r for r in self.replicas if r is not victim]
+        # outside the lock: stop joins the worker; its in-flight window
+        # fails over through run()'s ReplicaDrained path meanwhile
+        victim.stop(timeout=timeout)
+        return victim
 
     # --------------------------------------------------- lifecycle
     def close(self) -> None:

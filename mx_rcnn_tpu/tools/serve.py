@@ -25,6 +25,13 @@ Examples:
   # fetch-byte counters on
   python -m mx_rcnn_tpu.tools.serve --small \
       --model masks=mask_resnet_fpn:random:1
+
+  # tenant-fair front door (ISSUE 16): two rate-limited tenants at 3:1
+  # weights through the WFQ batcher, an elastic pool that may grow to 3
+  # replicas, and the socket frontend listening on port 7447
+  python -m mx_rcnn_tpu.tools.serve --small --replicas 1 --force_pool \
+      --tenant acme=3:50 --tenant beta=1:20 \
+      --autoscale_max 3 --frontend_port 7447
 """
 
 from __future__ import annotations
@@ -178,6 +185,22 @@ def main():
     p.add_argument("--swap", default=None, metavar="MODEL=CKPT_DIR",
                    help="hot-swap MODEL to the checkpoint mid-load (the "
                    "'swap <model> <ckpt>' admin command, exercised live)")
+    p.add_argument("--tenant", action="append", default=[],
+                   metavar="NAME=WEIGHT[:RATE[:BURST]]",
+                   help="register a tenant (repeatable): WFQ weight, "
+                   "optional token-bucket rate (req/s) and burst.  Any "
+                   "--tenant makes admission strict — untagged or unknown "
+                   "tenants are rejected at submit.  Load is spread "
+                   "uniformly over the registered tenants")
+    p.add_argument("--autoscale_max", type=int, default=0, metavar="N",
+                   help="attach the elastic autoscaler with this replica "
+                   "ceiling (pool path only); 0 disables")
+    p.add_argument("--autoscale_min", type=int, default=1,
+                   help="autoscaler floor (default 1)")
+    p.add_argument("--frontend_port", type=int, default=None, metavar="P",
+                   help="also serve the length-prefixed wire protocol on "
+                   "127.0.0.1:P for the duration of the load (0 = pick an "
+                   "ephemeral port)")
     p.add_argument("--out", default=None, help="write the report JSON here")
     args = p.parse_args()
 
@@ -238,6 +261,26 @@ def main():
         from mx_rcnn_tpu.serve.respcache import ResponseCache
 
         response_cache = ResponseCache(capacity=args.response_cache)
+    # --tenant NAME=WEIGHT[:RATE[:BURST]] → a strict TenantTable; the
+    # engine then runs token-bucket admission + WFQ release per tenant
+    tenants = None
+    tenant_names = None
+    if args.tenant:
+        from mx_rcnn_tpu.serve.tenancy import TenantTable
+
+        tenants = TenantTable(strict=True)
+        tenant_names = []
+        for spec in args.tenant:
+            name, _, rest = spec.partition("=")
+            if not name or not rest:
+                p.error(f"--tenant needs NAME=WEIGHT[:RATE[:BURST]], "
+                        f"got {spec!r}")
+            parts = rest.split(":")
+            weight = float(parts[0])
+            rate = float(parts[1]) if len(parts) > 1 and parts[1] else None
+            burst = float(parts[2]) if len(parts) > 2 and parts[2] else None
+            tenants.register(name, weight=weight, rate=rate, burst=burst)
+            tenant_names.append(name)
     engine = ServingEngine(
         runner,
         max_linger=args.linger_ms / 1000.0,
@@ -246,6 +289,7 @@ def main():
         interactive_linger=args.interactive_linger_ms / 1000.0,
         bulk_age_limit=args.bulk_age_limit,
         response_cache=response_cache,
+        tenants=tenants,
     )
     logger.info(
         "warming up %d bucket(s) x %d model(s) x %d replica(s)...",
@@ -275,23 +319,48 @@ def main():
         load_lanes = ["interactive"] + [None] * max(1, args.lane_mix - 1)
 
     with engine:
+        if args.autoscale_max > 0:
+            if not (args.replicas > 1 or args.force_pool):
+                p.error("--autoscale_max needs the pool path "
+                        "(--replicas > 1 or --force_pool)")
+            from mx_rcnn_tpu.serve.autoscaler import ScalePolicy
+
+            engine.attach_autoscaler(policy=ScalePolicy(
+                min_replicas=args.autoscale_min,
+                max_replicas=args.autoscale_max,
+            ))
+        frontend = None
+        if args.frontend_port is not None:
+            from mx_rcnn_tpu.serve.frontend import Frontend
+
+            frontend = Frontend(engine, port=args.frontend_port)
+            frontend.start()
+            logger.info("frontend listening on 127.0.0.1:%d", frontend.port)
         swapper = None
         if args.swap:
             swapper = threading.Thread(target=run_swap, name="admin-swap")
             swapper.start()
-        report = run_load(
-            engine,
-            num_requests=args.requests,
-            concurrency=args.concurrency,
-            sizes=sizes,
-            seed=args.seed,
-            deadline_s=(
-                args.deadline_ms / 1000.0
-                if args.deadline_ms is not None else None
-            ),
-            models=load_models,
-            lanes=load_lanes,
-        )
+        try:
+            report = run_load(
+                engine,
+                num_requests=args.requests,
+                concurrency=args.concurrency,
+                sizes=sizes,
+                seed=args.seed,
+                deadline_s=(
+                    args.deadline_ms / 1000.0
+                    if args.deadline_ms is not None else None
+                ),
+                models=load_models,
+                lanes=load_lanes,
+                tenants=tenant_names,
+            )
+        finally:
+            if frontend is not None:
+                frontend.stop()
+                report_frontend = frontend.snapshot()
+        if frontend is not None:
+            report["frontend"] = report_frontend
         if swapper is not None:
             swapper.join()
             report["swap"] = swap_result

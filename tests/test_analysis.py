@@ -379,6 +379,71 @@ def test_r4_ignores_non_serve_modules():
     assert run_rule(R4_DEVICE, LockOrder(), path="mx_rcnn_tpu/core/fx.py") == []
 
 
+# R4 against the ISSUE 16 tenancy shape: the batcher's WFQ release path
+# holds the batcher condition and calls the tenant table's weight()
+# (which takes TenantTable._lock as a leaf).  One-way is the shipped
+# design; a table method that calls BACK into the batcher under its own
+# lock closes the cycle graftlint must flag.
+
+R4_TENANCY_BAD = """
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+
+class Batcher:
+    def __init__(self):
+        self._cond = make_lock("Batcher._cond")
+        self.table = None
+
+    def release(self):
+        with self._cond:
+            return self.table.weight("acme")
+
+class Table:
+    def __init__(self):
+        self._lock = make_lock("Table._lock")
+        self.batcher = None
+
+    def weight(self, tenant):
+        with self._lock:
+            return 1.0
+
+    def over_share(self, tenant):
+        with self._lock:
+            return self.batcher.release()
+"""
+
+R4_TENANCY_GOOD = """
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+
+class Batcher:
+    def __init__(self):
+        self._cond = make_lock("Batcher._cond")
+        self.table = None
+
+    def release(self):
+        with self._cond:
+            return self.table.weight("acme")
+
+class Table:
+    def __init__(self):
+        self._lock = make_lock("Table._lock")
+
+    def weight(self, tenant):
+        with self._lock:
+            return 1.0
+"""
+
+
+def test_r4_fires_on_tenancy_lock_cycle():
+    fs = run_rule(R4_TENANCY_BAD, LockOrder(),
+                  path="mx_rcnn_tpu/serve/tenancy.py")
+    assert any("cycle" in f.message for f in fs)
+
+
+def test_r4_silent_on_tenancy_leaf_order():
+    assert run_rule(R4_TENANCY_GOOD, LockOrder(),
+                    path="mx_rcnn_tpu/serve/tenancy.py") == []
+
+
 # ---------------------------------------------------------------- R5
 
 R5_BAD = """
@@ -463,6 +528,46 @@ def test_r5_fires_on_droppable_window_entry():
 def test_r5_silent_on_settled_window_entry():
     assert run_rule(R5_OVERLAP_GOOD, ExactlyOnce(),
                     path="mx_rcnn_tpu/serve/fx.py") == []
+
+
+# R5 against the ISSUE 16 scale-down drain: the victim replica's queued
+# dispatches are a take source; popping one and bailing on the stop
+# flag without requeuing it on a sibling is a dropped request — exactly
+# the loss the zero-loss shrink bench would catch after the fact, and
+# graftlint flags at review time
+
+R5_DRAIN_BAD = """
+class Drainer:
+    def drain_victim(self):
+        while True:
+            d = self._victim_queue.get(timeout=0.02)
+            if self._stop:
+                return
+            if d is None:
+                break
+            self._sibling.dispatch(d)
+"""
+
+R5_DRAIN_GOOD = """
+class Drainer:
+    def drain_victim(self):
+        while True:
+            d = self._victim_queue.get(timeout=0.02)
+            if d is None:
+                break
+            self._sibling.dispatch(d)
+"""
+
+
+def test_r5_fires_on_dropped_drain_dispatch():
+    fs = run_rule(R5_DRAIN_BAD, ExactlyOnce(),
+                  path="mx_rcnn_tpu/serve/autoscaler.py")
+    assert len(fs) == 1 and "`d`" in fs[0].message
+
+
+def test_r5_silent_on_requeued_drain_dispatch():
+    assert run_rule(R5_DRAIN_GOOD, ExactlyOnce(),
+                    path="mx_rcnn_tpu/serve/autoscaler.py") == []
 
 
 # ---------------------------------------------------------------- R6

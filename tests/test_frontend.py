@@ -1,0 +1,466 @@
+"""Tenant-fair front door (ISSUE 16): admission, WFQ, wire protocol.
+
+Three layers, cheapest first (the serve-stack test split):
+
+* pure tenancy policy (no engine): token-bucket admission with an
+  injected clock, the over-share shed predicate, and the weighted-fair
+  credit scheduler — pick purity, exact weight ratios, no idle credit;
+* batcher + engine on a numpy runner stub: WFQ release interleave,
+  shed-over-budget-first under queue pressure, aggressor/victim
+  isolation (the victim completes everything while the aggressor is
+  rate-limited), and the per-tenant metrics partition;
+* the wire: a real Frontend on an ephemeral port — happy-path byte
+  identity against in-process submit, the malformed-frame rejection
+  matrix, and the typed error taxonomy (unknown_tenant / over_budget
+  at the socket).
+
+Every test runs with the lock-order checker armed, same as
+tests/test_slo.py.
+"""
+
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.serve.batcher import DynamicBatcher, Request
+from mx_rcnn_tpu.serve.buckets import BucketLadder, CompileCache
+from mx_rcnn_tpu.serve.engine import ServingEngine
+from mx_rcnn_tpu.serve.frontend import Frontend, FrontendClient
+from mx_rcnn_tpu.serve.tenancy import (
+    TenantOverBudget,
+    TenantPolicy,
+    TenantTable,
+    UnknownTenant,
+    WeightedFairScheduler,
+)
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_check(monkeypatch):
+    from mx_rcnn_tpu.analysis import lockcheck
+
+    monkeypatch.setenv("MX_RCNN_LOCK_CHECK", "1")
+    lockcheck.reset()
+    yield
+
+
+LADDER = ((32, 32), (48, 64))
+
+
+class FakeRunner:
+    """Runner-interface stub (tests/test_slo.py shape): real ladder and
+    assembly semantics, numpy predict, optional gate to hold batches
+    in-flight so queue pressure is deterministic."""
+
+    def __init__(self, service_s: float = 0.0, max_batch: int = 2,
+                 gate=None):
+        self.service_s = service_s
+        self.ladder = BucketLadder(LADDER)
+        self.max_batch = max_batch
+        self.cfg = None
+        self.compile_cache = CompileCache()
+        self.gate = gate
+
+    def warmup(self) -> int:
+        for bh, bw in self.ladder:
+            self.compile_cache.record(((self.max_batch, bh, bw, 3), "f32"))
+        return self.compile_cache.misses
+
+    def make_request(self, im, deadline=None) -> Request:
+        h, w = im.shape[:2]
+        bh, bw = self.ladder.select(h, w)
+        canvas = np.zeros((bh, bw, 3), np.float32)
+        canvas[:h, :w] = im
+        return Request(
+            image=canvas,
+            im_info=np.array([h, w, 1.0], np.float32),
+            orig_hw=(h, w),
+            bucket=(bh, bw),
+            deadline=deadline,
+        )
+
+    def assemble(self, requests):
+        images = [r.image for r in requests]
+        while len(images) < self.max_batch:
+            images.append(images[0])
+        return {"images": np.stack(images)}
+
+    def run(self, batch):
+        if self.gate is not None:
+            self.gate.wait(timeout=30.0)
+        if self.service_s:
+            time.sleep(self.service_s)
+        self.compile_cache.record((batch["images"].shape, "f32"))
+        im = batch["images"].astype(np.float64)
+        return {"digest": im.sum(axis=(1, 2, 3))}
+
+    def detections_for(self, out, batch, index, orig_hw=None, thresh=None):
+        return [np.array([out["digest"][index]])]
+
+
+def image(i: int, h: int = 24, w: int = 24) -> np.ndarray:
+    rng = np.random.RandomState(1000 + i)
+    return rng.rand(h, w, 3).astype(np.float32)
+
+
+def _req(tenant=None, bucket=(32, 32)):
+    return Request(
+        image=np.zeros((1,), np.uint8),
+        im_info=np.array([1.0, 1.0, 1.0], np.float32),
+        orig_hw=(1, 1),
+        bucket=bucket,
+        tenant=tenant,
+    )
+
+
+# ------------------------------------------------------------ tenant table
+class TestTenantTable:
+    def test_strict_rejects_unknown(self):
+        t = TenantTable(strict=True)
+        t.register("acme")
+        with pytest.raises(UnknownTenant):
+            t.admit("nobody")
+        assert t.unknown_rejected == 1
+        t.admit("acme")  # registered passes
+        t.admit(None)  # untagged always passes
+
+    def test_nonstrict_auto_registers_at_default(self):
+        t = TenantTable(strict=False, default=TenantPolicy(weight=2.0))
+        t.admit("walkin")
+        assert t.weight("walkin") == 2.0
+        assert t.admitted["walkin"] == 1
+
+    def test_token_bucket_rate_limit_deterministic(self):
+        t = TenantTable()
+        t.register("acme", rate=2.0, burst=2.0)
+        now = 100.0
+        t.admit("acme", now=now)
+        t.admit("acme", now=now)
+        with pytest.raises(TenantOverBudget):
+            t.admit("acme", now=now)
+        # 0.5 s refills exactly one token at 2 req/s
+        t.admit("acme", now=now + 0.5)
+        with pytest.raises(TenantOverBudget):
+            t.admit("acme", now=now + 0.5)
+        assert t.admitted["acme"] == 3
+        assert t.over_budget["acme"] == 2
+
+    def test_burst_caps_idle_accumulation(self):
+        t = TenantTable()
+        t.register("acme", rate=10.0, burst=3.0)
+        now = 50.0
+        # a week idle banks exactly `burst` tokens, not rate * elapsed
+        ok = 0
+        for _ in range(10):
+            try:
+                t.admit("acme", now=now + 604800.0)
+                ok += 1
+            except TenantOverBudget:
+                break
+        assert ok == 3
+
+    def test_over_share_predicate(self):
+        t = TenantTable()
+        t.register("big", weight=3.0)
+        t.register("small", weight=1.0)
+        queued = {"big": 5, "small": 5}
+        # shares of the 10 queued: big 7.5, small 2.5
+        assert not t.over_share("big", queued)
+        assert t.over_share("small", queued)
+        assert not t.over_share(None, queued)
+        assert not t.over_share("big", {})
+
+
+# ---------------------------------------------------------- WFQ scheduler
+class TestWeightedFairScheduler:
+    def test_equal_weights_round_robin(self):
+        s = WeightedFairScheduler()
+        order = []
+        for _ in range(6):
+            t = s.pick(["a", "b"])
+            order.append(t)
+            s.charge(t, 1, ["a", "b"])
+        assert order == ["a", "b", "a", "b", "a", "b"]
+
+    def test_pick_is_pure(self):
+        # the batcher calls pick repeatedly while lingering; repeats
+        # must not advance fairness state
+        s = WeightedFairScheduler()
+        first = s.pick(["a", "b"])
+        for _ in range(50):
+            assert s.pick(["a", "b"]) == first
+
+    def test_weight_ratio_exact(self):
+        weights = {"big": 3.0, "small": 1.0}
+        s = WeightedFairScheduler(weight_fn=lambda t: weights[t])
+        served = {"big": 0, "small": 0}
+        for _ in range(400):
+            t = s.pick(["big", "small"])
+            served[t] += 1
+            s.charge(t, 1, ["big", "small"])
+        assert served["big"] == 300
+        assert served["small"] == 100
+
+    def test_idle_tenant_banks_nothing(self):
+        s = WeightedFairScheduler()
+        # only "a" is active for a long stretch
+        for _ in range(100):
+            s.charge("a", 1, ["a"])
+        # "b" shows up: credit is granted only at charge time to active
+        # tenants, so "b" competes from par — bounded alternation, not a
+        # 100-request catch-up burst
+        burst = 0
+        while s.pick(["a", "b"]) == "b" and burst < 10:
+            s.charge("b", 1, ["a", "b"])
+            burst += 1
+        assert burst <= 1
+
+
+# ------------------------------------------------------- batcher WFQ release
+class TestBatcherWFQ:
+    def test_release_interleave_matches_weights(self):
+        weights = {"big": 3.0, "small": 1.0}
+        fair = WeightedFairScheduler(weight_fn=lambda t: weights[t])
+        b = DynamicBatcher(max_batch=1, max_linger=0.0, fair=fair)
+        for _ in range(8):
+            b.submit(_req(tenant="big"))
+            b.submit(_req(tenant="small"))
+        order = []
+        for _ in range(16):
+            batch = b.next_batch()
+            order.append(batch[0].tenant)
+        # 3:1 long-run ratio with both tenants backlogged
+        assert order.count("big") == 8 and order.count("small") == 8
+        assert order[:8].count("big") == 6  # 3:1 while both are active
+        stats = b.stats()
+        assert stats["released_by_tenant"] == {"big": 8, "small": 8}
+        assert "fair" in stats
+
+    def test_single_tenant_bypasses_filter(self):
+        fair = WeightedFairScheduler()
+        b = DynamicBatcher(max_batch=2, max_linger=0.0, fair=fair)
+        b.submit(_req(tenant="only"))
+        assert [r.tenant for r in b.next_batch()] == ["only"]
+
+
+# ------------------------------------------------------------ engine layer
+def make_tenants(**specs) -> TenantTable:
+    t = TenantTable(strict=True)
+    for name, kw in specs.items():
+        t.register(name, **kw)
+    return t
+
+
+class TestEngineTenancy:
+    def test_unknown_tenant_rejected_synchronously(self):
+        engine = ServingEngine(FakeRunner(), max_linger=0.0,
+                               tenants=make_tenants(acme={}))
+        with engine:
+            with pytest.raises(UnknownTenant):
+                engine.submit(image(0), tenant="nobody")
+            engine.submit(image(0), tenant="acme").result(timeout=10.0)
+        snap = engine.snapshot()
+        assert snap["tenancy"]["unknown_rejected"] == 1
+        assert snap["requests"]["rejected"] == 1
+
+    def test_rate_limited_tenant_over_budget(self):
+        engine = ServingEngine(
+            FakeRunner(), max_linger=0.0,
+            tenants=make_tenants(acme={"rate": 2.0, "burst": 2.0}),
+        )
+        with engine:
+            ok, over = 0, 0
+            for i in range(10):
+                try:
+                    engine.submit(image(i), tenant="acme")
+                    ok += 1
+                except TenantOverBudget:
+                    over += 1
+        # 10 instant submits through a 2-token bucket: the burst passes,
+        # the rest are over budget (a stray refill tick may admit one)
+        assert 2 <= ok <= 3 and over == 10 - ok
+        snap = engine.snapshot()
+        assert snap["requests"]["over_budget"] == over
+        assert snap["tenants"]["acme"]["rejected"] == over
+
+    def test_shed_over_budget_tenant_first(self):
+        import threading
+
+        gate = threading.Event()
+        engine = ServingEngine(
+            FakeRunner(gate=gate), max_linger=0.0, max_queue=8,
+            in_flight=1, shed_fraction=0.5,
+            tenants=make_tenants(aggressor={}, victim={}),
+        )
+        with engine:
+            futs = []
+            # flood from one tenant while the runner is gated shut; wait
+            # until the queue is past the shed threshold (0.5 * 8 = 4)
+            for i in range(8):
+                try:
+                    futs.append(engine.submit(image(i), tenant="aggressor"))
+                except TenantOverBudget:
+                    break
+            assert engine.batcher.pending() >= 4
+            # the aggressor holds ~100% of the backlog → over share → shed
+            with pytest.raises(TenantOverBudget):
+                engine.submit(image(90), tenant="aggressor")
+            # the victim holds none → admitted despite the pressure
+            vf = engine.submit(image(91), tenant="victim")
+            gate.set()
+            vf.result(timeout=10.0)
+            for f in futs:
+                f.result(timeout=10.0)
+        snap = engine.snapshot()
+        assert snap["requests"]["tenant_shed"] >= 1
+        assert snap["tenancy"]["shed"].get("aggressor", 0) >= 1
+        assert "victim" not in snap["tenancy"]["shed"]
+        assert snap["tenants"]["victim"]["completed"] == 1
+
+    def test_aggressor_victim_isolation(self):
+        # the aggressor blasts far past its rate limit; the victim is
+        # unlimited.  Every victim request completes, the aggressor's
+        # excess is rejected at the door, and victim latency stays
+        # bounded because the shed happens BEFORE the queue
+        engine = ServingEngine(
+            FakeRunner(service_s=0.001), max_linger=0.0, max_queue=64,
+            tenants=make_tenants(
+                aggressor={"rate": 5.0, "burst": 5.0},
+                victim={"weight": 1.0},
+            ),
+        )
+        with engine:
+            victim_futs, agg_ok, agg_rejected = [], 0, 0
+            for i in range(20):
+                for _ in range(3):  # aggressor at 3x the victim's rate
+                    try:
+                        engine.submit(image(i), tenant="aggressor")
+                        agg_ok += 1
+                    except TenantOverBudget:
+                        agg_rejected += 1
+                victim_futs.append(engine.submit(image(i), tenant="victim"))
+            for f in victim_futs:
+                f.result(timeout=30.0)
+        snap = engine.snapshot()
+        vic = snap["tenants"]["victim"]
+        assert vic["completed"] == 20
+        assert agg_rejected >= 40  # 60 attempts through a 5-token bucket
+        assert snap["tenants"]["aggressor"]["rejected"] \
+            == agg_rejected
+        # victim latency bounded: the aggressor's excess never queued
+        assert vic["e2e"]["p99_ms"] < 5000.0
+
+
+# ------------------------------------------------------------------ wire
+_LEN = struct.Struct(">I")
+
+
+def frame(header_bytes: bytes, body: bytes = b"") -> bytes:
+    return header_bytes + body
+
+
+def good_header(**over) -> bytes:
+    import json
+
+    h = {"tenant": "acme", "dtype": "uint8", "shape": [2, 2, 3]}
+    h.update(over)
+    return json.dumps(h).encode() + b"\n"
+
+
+@pytest.fixture()
+def served_engine():
+    engine = ServingEngine(FakeRunner(), max_linger=0.0,
+                           tenants=make_tenants(
+                               acme={}, limited={"rate": 1.0, "burst": 1.0}))
+    with engine:
+        fe = Frontend(engine)
+        fe.start()
+        try:
+            yield engine, fe
+        finally:
+            fe.stop()
+
+
+class TestFrontendWire:
+    def test_round_trip_matches_in_process(self, served_engine):
+        engine, fe = served_engine
+        im = image(7)
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            resp = cli.request(im, tenant="acme")
+        assert resp["ok"]
+        ref = engine.submit(im, tenant="acme").result(timeout=10.0)
+        np.testing.assert_allclose(
+            np.asarray(resp["detections"][0]), ref[0]
+        )
+
+    @pytest.mark.parametrize("payload", [
+        b"no header terminator at all",
+        b"not json\n" + b"x" * 12,
+        b"[1, 2, 3]\n",  # header not an object
+        good_header(tenant=None),
+        good_header(tenant=""),
+        good_header(tenant=7),
+        good_header(dtype="float64") + b"\x00" * 96,
+        good_header(shape=[2, 2]) + b"\x00" * 12,
+        good_header(shape=[2, 2, 4]) + b"\x00" * 16,
+        good_header(shape=[0, 2, 3]),
+        good_header() + b"\x00" * 5,  # byte count != 2*2*3
+    ], ids=["no-newline", "bad-json", "non-dict", "tenant-null",
+            "tenant-empty", "tenant-nonstring", "bad-dtype", "shape-2d",
+            "shape-not-rgb", "shape-zero", "byte-mismatch"])
+    def test_malformed_frame_matrix(self, served_engine, payload):
+        _, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            resp = cli.send_raw(payload)
+        assert resp["ok"] is False
+        assert resp["error"] == "invalid_frame"
+
+    def test_malformed_frames_count_and_connection_survives(
+            self, served_engine):
+        _, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            assert cli.send_raw(b"junk")["error"] == "invalid_frame"
+            # same connection still serves a good frame afterwards
+            resp = cli.request(image(1), tenant="acme")
+            assert resp["ok"]
+        assert fe.rejected_frames == 1
+        assert fe.errors["invalid_frame"] == 1
+
+    def test_oversize_length_prefix_closes_connection(self, served_engine):
+        _, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            resp = cli.send_raw(
+                _LEN.pack(fe.max_frame + 1), prefix=False
+            )
+            assert resp["error"] == "invalid_frame"
+            # stream offset is untrusted after a length violation: the
+            # server hangs up rather than resynchronize
+            with pytest.raises(ConnectionError):
+                cli.request(image(1), tenant="acme")
+
+    def test_unknown_tenant_typed_error(self, served_engine):
+        _, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            resp = cli.request(image(2), tenant="nobody")
+        assert resp["ok"] is False
+        assert resp["error"] == "unknown_tenant"
+        assert fe.errors["unknown_tenant"] == 1
+
+    def test_over_budget_typed_error(self, served_engine):
+        _, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            first = cli.request(image(3), tenant="limited")
+            second = cli.request(image(4), tenant="limited")
+        assert first["ok"]
+        assert second["ok"] is False
+        assert second["error"] == "over_budget"
+
+    def test_snapshot_counters(self, served_engine):
+        _, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            cli.request(image(5), tenant="acme")
+        snap = fe.snapshot()
+        assert snap["accepted"] == 1
+        assert snap["frames"] == 1
