@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault serve-mask serve-scale swap slo poison pipeline elastic chaos integration-gate clean-native
+.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault serve-mask serve-scale swap rollout slo poison pipeline elastic chaos integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -132,6 +132,16 @@ swap:
 	JAX_PLATFORMS=cpu $(PY) bench.py --swap --serve_requests 24 \
 	      --serve_concurrency 6 --serve_max_batch 2 --serve_replicas 2 \
 	      --out BENCH_swap_cpu.json
+
+# progressive-rollout bench (ISSUE 17): traffic-split canary promote
+# under load (zero lost, byte-identical, zero recompiles), shadow-mode
+# divergence auto-rollback with the incumbent serving identical bytes
+# throughout, and the closed serve->distill->fine-tune->promote loop;
+# emits JSON lines + the BENCH_rollout_cpu.json artifact
+rollout:
+	JAX_PLATFORMS=cpu $(PY) bench.py --rollout --serve_requests 24 \
+	      --serve_concurrency 6 --serve_max_batch 2 \
+	      --out BENCH_rollout_cpu.json
 
 # SLO-tier serving bench (ISSUE 11): sparse interactive probes against
 # a saturating bulk backlog, single-lane baseline vs two-lane scheduling

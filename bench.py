@@ -1812,6 +1812,332 @@ def bench_swap(
     return records, report
 
 
+def bench_rollout(
+    network: str,
+    requests: int,
+    concurrency: int,
+    max_batch: int,
+    linger_ms: float,
+    small: bool = True,
+    distill_steps: int = 2,
+) -> tuple:
+    """Progressive-rollout bench (ISSUE 17): the full candidate
+    lifecycle on the real serve stack, CPU-runnable.
+
+    Three scenarios, all with ``deterministic=True`` runners so
+    detections are bitwise comparable across waves:
+
+    * ``split_promote`` — a faithful candidate (byte-identical weights,
+      new version) rolls out under live load with a 30% traffic split
+      and shadow scoring; the evaluator must promote it with zero lost
+      requests, zero failed requests, every response byte-identical to
+      the v1 reference, and ZERO compile misses from warmup onward
+      (candidate warms through the already-compiled executables).
+    * ``shadow_rollback`` — a divergent candidate (different random
+      init) runs in pure shadow mode (0% split): live traffic must stay
+      byte-identical to the incumbent for the whole rollout, the shadow
+      comparisons must trip the divergence bounds, and the controller
+      must auto-roll-back leaving v1 LIVE and the candidate RETIRED.
+    * ``closed_loop`` — served detections are harvested with
+      ``tools/distill.py`` into synthetic-schema records, fine-tuned
+      with the existing trainer, and the resulting checkpoint is
+      submitted back through the rollout — serve→train→serve, ending
+      with the distilled model promoted to LIVE.
+    """
+    import os
+    import tempfile
+
+    import jax
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.core.checkpoint import save_checkpoint
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.loadgen import DEFAULT_SIZES, run_load
+    from mx_rcnn_tpu.serve.registry import DEFAULT_MODEL, ModelRegistry
+    from mx_rcnn_tpu.serve.rollout import RolloutAborted, RolloutPolicy
+    from mx_rcnn_tpu.serve.runner import ServeRunner
+    from mx_rcnn_tpu.tools import distill
+    from mx_rcnn_tpu.tools.serve import small_config
+
+    if small:
+        cfg = small_config(network)
+        sizes = ((72, 96), (96, 128), (64, 80))
+    else:
+        cfg = generate_config(network, "PascalVOC")
+        sizes = DEFAULT_SIZES
+    model = build_model(cfg)
+    h, w = cfg.SHAPE_BUCKETS[0]
+
+    def init_params(seed):
+        return model.init(
+            {"params": jax.random.key(seed)},
+            np.zeros((1, h, w, 3), np.float32),
+            np.array([[h, w, 1.0]], np.float32),
+            train=False,
+        )["params"]
+
+    params_v1 = init_params(0)
+    tmp = tempfile.mkdtemp(prefix="bench-rollout-")
+    # faithful candidate: byte-identical weights under a new version —
+    # shadow divergence is exactly zero, the promote path is pure
+    # lifecycle mechanics
+    ckpt_faithful = save_checkpoint(
+        os.path.join(tmp, "faithful"), {"params": params_v1}, 1
+    )
+    # divergent candidate: a different random init — same structure
+    # (admitted by the verify gate) but wildly different detections
+    ckpt_divergent = save_checkpoint(
+        os.path.join(tmp, "divergent"), {"params": init_params(1)}, 1
+    )
+
+    def make_engine():
+        reg = ModelRegistry()
+        reg.register(DEFAULT_MODEL, model, cfg, params_v1)
+        runner = ServeRunner(
+            registry=reg, max_batch=max_batch, deterministic=True
+        )
+        eng = ServingEngine(
+            runner, max_linger=linger_ms / 1000.0, in_flight=2
+        )
+        return eng, reg
+
+    def load(eng, n=requests):
+        return run_load(
+            eng, num_requests=n, concurrency=concurrency, sizes=sizes,
+            seed=0, collect=True,
+        )
+
+    def ok_dets(report):
+        return {
+            i: r for i, (kind, r) in report["_results"].items() if kind == "ok"
+        }
+
+    def wave_summary(report):
+        out = report["outcomes"]
+        resolved = out["ok"] + out["deadline"] + out["error"]
+        return {
+            "outcomes": out,
+            "lost_requests": report["requests"] - resolved,
+            "imgs_per_sec": report["imgs_per_sec"],
+        }
+
+    def wait_state(ro, timeout=180.0):
+        t_end = time.time() + timeout
+        while time.time() < t_end:
+            if ro.state == "evaluating" or ro.done():
+                return
+            time.sleep(0.01)
+
+    # all waves share seed=0, so detections are comparable by index
+    n_wave = 2 * requests
+
+    # -------------------------------------- scenario 1: split_promote
+    eng, reg = make_engine()
+    with eng:
+        ctl = eng.attach_rollout()
+        rep_ref = load(eng, n=n_wave)
+        ref_v1 = ok_dets(rep_ref)
+        misses_warm = eng.snapshot()["compile"]["misses"]
+        ro = ctl.start(DEFAULT_MODEL, ckpt_faithful, policy=RolloutPolicy(
+            split_pct=30.0, shadow=True, min_compared=4,
+            min_served=max(4, requests // 8),
+            min_error_samples=10**6, min_latency_samples=10**6,
+            hold_s=0.2, eval_interval_s=0.02, score_thresh=0.01,
+        ))
+        wait_state(ro)
+        rep_b = load(eng, n=n_wave)
+        promote = ro.result(300)
+        rep_c = load(eng, n=n_wave)
+        snap = eng.snapshot()
+    misses_end = snap["compile"]["misses"]
+    dets_b, dets_c = ok_dets(rep_b), ok_dets(rep_c)
+    # faithful weights: EVERY response — either arm, before or after
+    # the flip — must match the v1 reference byte-for-byte
+    split_identical = bool(dets_b) and all(
+        _dets_equal(dets_b[i], ref_v1[i]) for i in dets_b
+    )
+    post_identical = bool(dets_c) and all(
+        _dets_equal(dets_c[i], ref_v1[i]) for i in dets_c
+    )
+    waves = [wave_summary(r) for r in (rep_ref, rep_b, rep_c)]
+    promote_lost = sum(wv["lost_requests"] for wv in waves)
+    promote_failed = sum(
+        wv["outcomes"]["error"] + wv["outcomes"]["deadline"] for wv in waves
+    )
+    split_promote = {
+        "wave_requests": n_wave,
+        "waves": waves,
+        "lost_requests": promote_lost,
+        "failed_requests": promote_failed,
+        "promote": promote,
+        "split_served": promote.get("split_served"),
+        "split_identical_bytes": split_identical,
+        "post_promote_identical_bytes": post_identical,
+        "live_version": reg.live(DEFAULT_MODEL).version,
+        "compile_misses_after_warmup": misses_warm,
+        "compile_misses_final": misses_end,
+        "recompiles_through_rollout": misses_end - misses_warm,
+    }
+
+    # ------------------------------------ scenario 2: shadow_rollback
+    eng2, reg2 = make_engine()
+    with eng2:
+        ctl2 = eng2.attach_rollout()
+        rep_ref2 = load(eng2, n=n_wave)
+        ref2_v1 = ok_dets(rep_ref2)
+        ro2 = ctl2.start(DEFAULT_MODEL, ckpt_divergent, policy=RolloutPolicy(
+            split_pct=0.0, shadow=True, min_compared=4,
+            min_error_samples=10**6, min_latency_samples=10**6,
+            hold_s=3600.0, eval_interval_s=0.02, score_thresh=0.01,
+        ))
+        wait_state(ro2)
+        rep_b2 = load(eng2, n=n_wave)
+        rollback = {"aborted": False}
+        try:
+            ro2.result(300)
+        except RolloutAborted as e:
+            rollback["aborted"] = True
+            rollback["stage"] = e.stage
+            rollback["cause"] = str(e.cause)
+        rep_c2 = load(eng2, n=n_wave)
+        ctl2.stop()
+    divergence = ro2.report.snapshot()
+    dets_b2, dets_c2 = ok_dets(rep_b2), ok_dets(rep_c2)
+    incumbent_identical = (
+        bool(dets_b2) and bool(dets_c2)
+        and all(_dets_equal(dets_b2[i], ref2_v1[i]) for i in dets_b2)
+        and all(_dets_equal(dets_c2[i], ref2_v1[i]) for i in dets_c2)
+    )
+    rollback.update({
+        "waves": [wave_summary(r) for r in (rep_ref2, rep_b2, rep_c2)],
+        "incumbent_identical_bytes": incumbent_identical,
+        "live_version": reg2.live(DEFAULT_MODEL).version,
+        "divergence": divergence,
+    })
+
+    # --------------------------------------- scenario 3: closed_loop
+    eng3, reg3 = make_engine()
+    with eng3:
+        ctl3 = eng3.attach_rollout()
+        rep_h = load(eng3, n=n_wave)
+        # regenerate the loadgen size stream (same rng discipline as
+        # run_load) so each harvested response carries its true (h, w)
+        size_rng = np.random.RandomState(0)
+        req_sizes = [
+            sizes[size_rng.randint(len(sizes))] for _ in range(n_wave)
+        ]
+        harvested = ok_dets(rep_h)
+        records_in = distill.harvest(
+            [(harvested[i], req_sizes[i]) for i in sorted(harvested)],
+            min_score=0.05,
+            num_classes=cfg.dataset.NUM_CLASSES,
+        )
+        rec_path = os.path.join(tmp, "distilled.jsonl")
+        distill.write_records(records_in, rec_path)
+        loop = {"harvested_records": len(records_in)}
+        if records_in:
+            ckpt_distilled = distill.fine_tune(
+                distill.read_records(rec_path), network=network,
+                steps=distill_steps, seed=0,
+                out_dir=os.path.join(tmp, "loop"),
+                init_donor=params_v1,
+            )
+            # a genuinely retrained candidate diverges by design: the
+            # loop's gate is lifecycle evidence (split health), with the
+            # divergence bounds opened up by the operator
+            ro3 = ctl3.start(DEFAULT_MODEL, ckpt_distilled, policy=RolloutPolicy(
+                split_pct=30.0, shadow=False, min_compared=0,
+                min_served=4,
+                max_box_delta_px=1e9, max_score_delta=1e9,
+                max_unmatched=10**6, max_count_drift=1e9,
+                min_error_samples=10**6, min_latency_samples=10**6,
+                hold_s=0.2, eval_interval_s=0.02,
+            ))
+            wait_state(ro3)
+            rep_l = load(eng3, n=n_wave)
+            loop_promote = ro3.result(300)
+            loop.update({
+                "checkpoint": ckpt_distilled,
+                "promote": loop_promote,
+                "waves": [wave_summary(r) for r in (rep_h, rep_l)],
+                "lost_requests": sum(
+                    wave_summary(r)["lost_requests"] for r in (rep_h, rep_l)
+                ),
+                "live_version": reg3.live(DEFAULT_MODEL).version,
+            })
+
+    tag = _METRIC_NAMES[network].replace("_e2e", "")
+    claims = {
+        "zero_lost_requests": bool(
+            promote_lost == 0 and promote_failed == 0
+            and loop.get("lost_requests") == 0
+        ),
+        "control_arm_byte_identical": bool(
+            split_identical and incumbent_identical
+        ),
+        "divergence_auto_rollback": bool(
+            rollback["aborted"] and rollback.get("stage") == "evaluate"
+            and rollback["live_version"] == 1
+            and incumbent_identical
+        ),
+        "zero_steady_state_recompiles": bool(
+            split_promote["recompiles_through_rollout"] == 0
+        ),
+        "closed_loop_promoted": bool(
+            loop.get("harvested_records", 0) > 0
+            and loop.get("live_version") == 2
+        ),
+    }
+    records = [
+        {
+            "metric": f"rollout_split_served_{tag}",
+            "value": split_promote["split_served"], "unit": "requests",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"rollout_shadow_compared_{tag}",
+            "value": divergence["compared"], "unit": "comparisons",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"rollout_promote_lost_requests_{tag}",
+            "value": promote_lost, "unit": "requests", "vs_baseline": None,
+        },
+        {
+            "metric": f"rollout_rollback_incumbent_identical_{tag}",
+            "value": int(incumbent_identical), "unit": "bool",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"rollout_steady_state_recompiles_{tag}",
+            "value": split_promote["recompiles_through_rollout"],
+            "unit": "compiles", "vs_baseline": None,
+        },
+        {
+            "metric": f"rollout_distill_records_{tag}",
+            "value": loop.get("harvested_records", 0), "unit": "records",
+            "vs_baseline": None,
+        },
+        {
+            "metric": f"rollout_loop_promoted_version_{tag}",
+            "value": loop.get("live_version"), "unit": "version",
+            "vs_baseline": None,
+        },
+    ]
+    report = {
+        "requests": requests,
+        "concurrency": concurrency,
+        "max_batch": max_batch,
+        "split_promote": split_promote,
+        "shadow_rollback": rollback,
+        "closed_loop": loop,
+        "divergence": divergence,
+        "claims": claims,
+    }
+    return records, report
+
+
 def _smoke_config(batch_images: int):
     """Tiny CPU-runnable train config (96×96 bucket, shrunk RPN/ROI
     budgets) — the same shrink the CLI smoke tests use, so the pipeline
@@ -2697,6 +3023,15 @@ def main():
              "two-family tenancy through one batcher",
     )
     ap.add_argument(
+        "--rollout", action="store_true",
+        help="progressive-rollout bench (ISSUE 17): traffic-split canary "
+             "promote under load (zero lost, zero recompiles), shadow-mode "
+             "divergence auto-rollback with a byte-identical incumbent, "
+             "and the closed serve->distill->fine-tune->promote loop",
+    )
+    ap.add_argument("--distill_steps", type=int, default=2,
+                    help="fine-tune steps for the closed-loop scenario")
+    ap.add_argument(
         "--serve_full", action="store_true",
         help="serve at the full config (default: tiny CPU-runnable one)",
     )
@@ -2802,6 +3137,20 @@ def main():
             probe_spacing_s=args.slo_probe_spacing,
             bulk_concurrency=args.slo_bulk_concurrency,
             max_batch=args.serve_max_batch // 2 or 1,
+        )
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
+
+    if args.rollout:
+        network = "resnet50" if args.network == "resnet" else args.network
+        records, report = bench_rollout(
+            network, args.serve_requests, args.serve_concurrency,
+            args.serve_max_batch, args.serve_linger_ms,
+            small=not args.serve_full, distill_steps=args.distill_steps,
         )
         for rec in records:
             print(json.dumps(rec), flush=True)

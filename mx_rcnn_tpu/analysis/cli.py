@@ -312,6 +312,66 @@ def _check_scale_schema(name: str, doc: dict) -> List[str]:
     return errors
 
 
+# progressive rollout bench (ISSUE 17): the artifact must prove the
+# full closed loop — zero requests lost through split + promote, the
+# control arm byte-identical to a no-rollout run, the divergence-
+# injected candidate auto-rolled-back while the incumbent kept serving,
+# zero steady-state recompiles end to end, and the distilled candidate
+# promoted through the serve→train→serve loop — plus the shadow
+# divergence evidence the rollback claim rests on.
+_ROLLOUT_CLAIMS = (
+    "zero_lost_requests",
+    "control_arm_byte_identical",
+    "divergence_auto_rollback",
+    "zero_steady_state_recompiles",
+    "closed_loop_promoted",
+)
+
+_ROLLOUT_METRIC_PREFIXES = (
+    "rollout_split_served",
+    "rollout_shadow_compared",
+    "rollout_promote_lost_requests",
+    "rollout_rollback_incumbent_identical",
+    "rollout_steady_state_recompiles",
+    "rollout_distill_records",
+    "rollout_loop_promoted_version",
+)
+
+
+def _check_rollout_schema(name: str, doc: dict) -> List[str]:
+    errors = []
+    report = doc.get("report") if isinstance(doc, dict) else None
+    if not isinstance(report, dict):
+        return [f"bench artifact {name}: missing report object"]
+    claims = report.get("claims")
+    if not isinstance(claims, dict):
+        return [f"bench artifact {name}: report.claims missing"]
+    for c in _ROLLOUT_CLAIMS:
+        if c not in claims:
+            errors.append(f"bench artifact {name}: claim '{c}' missing")
+        elif claims[c] is not True:
+            errors.append(f"bench artifact {name}: claim '{c}' not true")
+    div = report.get("divergence")
+    if not isinstance(div, dict) or not {
+        "compared", "max_box_delta_px"
+    } <= set(div):
+        errors.append(
+            f"bench artifact {name}: report.divergence incomplete — the "
+            f"rollback claim has no shadow-comparison evidence"
+        )
+    metrics = {
+        r.get("metric", "")
+        for r in doc.get("records", [])
+        if isinstance(r, dict)
+    }
+    for prefix in _ROLLOUT_METRIC_PREFIXES:
+        if not any(m.startswith(prefix) for m in metrics):
+            errors.append(
+                f"bench artifact {name}: no record metric '{prefix}*'"
+            )
+    return errors
+
+
 def check_bench_artifacts(root: Path) -> List[str]:
     errors = []
     for f in sorted(root.glob("BENCH_*.json")):
@@ -335,6 +395,8 @@ def check_bench_artifacts(root: Path) -> List[str]:
             errors += _check_mask_schema(f.name, doc)
         if f.name == "BENCH_serve_scale_cpu.json":
             errors += _check_scale_schema(f.name, doc)
+        if f.name == "BENCH_rollout_cpu.json":
+            errors += _check_rollout_schema(f.name, doc)
     return errors
 
 

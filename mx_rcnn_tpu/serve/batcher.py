@@ -110,6 +110,7 @@ class Request:
     budget: Optional[object] = None      # quarantine.RetryBudget (engine-set)
     solo: bool = False                   # engine resubmit: release as batch-of-1
     tenant: Optional[str] = None         # fair-share identity (None = untagged)
+    arm_version: Optional[int] = None    # rollout split arm (None = incumbent)
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

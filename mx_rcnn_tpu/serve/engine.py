@@ -149,6 +149,10 @@ class ServingEngine:
         # elastic capacity (ISSUE 16): a background AutoScaler attached
         # via attach_autoscaler; stop() joins it BEFORE pool teardown
         self.autoscaler = None
+        # progressive rollout (ISSUE 17): a RolloutController attached
+        # via attach_rollout — submit consults it for arm assignment,
+        # _complete feeds it evidence; stop() joins it with the swaps
+        self.rollout = None
         # every not-yet-resolved request, so stop() can sweep leftovers
         # with a terminal EngineStopped instead of stranding submitters
         self._live: Dict[int, Request] = {}
@@ -194,6 +198,11 @@ class ServingEngine:
         reg = getattr(self.runner, "registry", None)
         if reg is not None:
             reg.cancel_swaps(wait=True)
+        if self.rollout is not None:
+            # same interlock as swaps: cancel in-flight rollouts and
+            # join the shadow worker before any pool/batcher teardown,
+            # so no rollout-side device work runs after stop returns
+            self.rollout.stop()
         if self.autoscaler is not None:
             self.autoscaler.stop()
         if not drain:
@@ -236,6 +245,43 @@ class ServingEngine:
         if start:
             self.autoscaler.start()
         return self.autoscaler
+
+    def attach_rollout(self, policy=None):
+        """Create a
+        :class:`~mx_rcnn_tpu.serve.rollout.RolloutController` bound to
+        this engine's registry and runner/pool.  From here ``submit``
+        consults it for deterministic arm assignment, ``_complete``
+        feeds it per-arm evidence and mirrors incumbent completions
+        into the shadow lane, and ``stop()`` joins it alongside the
+        swap interlock."""
+        reg = getattr(self.runner, "registry", None)
+        if reg is None:
+            raise RuntimeError(
+                "progressive rollout needs a registry-backed "
+                "ServeRunner/ReplicaPool"
+            )
+        from mx_rcnn_tpu.serve.rollout import RolloutController
+
+        self.rollout = RolloutController(
+            reg, self.runner, engine=self, policy=policy
+        )
+        return self.rollout
+
+    def _resolved_mid(self, model: Optional[str]) -> Optional[str]:
+        """Registry model id a request resolves to (the rollout tables
+        are keyed by it, never by None)."""
+        if model is not None:
+            return model
+        mid = getattr(self.runner, "default_model", None)
+        if mid is not None:
+            return mid
+        reg = getattr(self.runner, "registry", None)
+        if reg is not None:
+            try:
+                return reg.default_model
+            except Exception:  # noqa: BLE001 — empty registry
+                return None
+        return None
 
     def __enter__(self) -> "ServingEngine":
         return self.start()
@@ -346,9 +392,26 @@ class ServingEngine:
                     f"digest {digest[:12]} is quarantined (query of death)"
                 )
         lane = self._lane_for(model, lane)
+        arm_version = None
+        if self.rollout is not None:
+            # deterministic arm assignment (ISSUE 17): the content
+            # digest — not a coin flip — picks the arm, so a repeated
+            # request always lands on the same version and the response
+            # cache stays arm-coherent by construction
+            mid_r = self._resolved_mid(model)
+            if mid_r is not None and self.rollout.active(mid_r):
+                if digest is None:
+                    digest = request_digest(im)
+                arm_version = self.rollout.arm_for(mid_r, digest)
         cache_key = None
         if self.response_cache is not None:
-            version = self._live_version(model)
+            # split serving: the key carries the SERVED arm's version,
+            # not the live pointer — two versions serve concurrently
+            # under a split and must never share cache entries
+            version = (
+                arm_version if arm_version is not None
+                else self._live_version(model)
+            )
             if version is not None:
                 t0 = time.monotonic()
                 reg = getattr(self.runner, "registry", None)
@@ -430,7 +493,14 @@ class ServingEngine:
             req.cache_key = cache_key
             if digest is not None:
                 req.digest = digest
-                req.budget = RetryBudget(self._retry_budget)
+                if self._quarantine is not None:
+                    req.budget = RetryBudget(self._retry_budget)
+            if arm_version is not None:
+                # candidate-arm requests release as a batch-of-1 (solo):
+                # a device batch is never a mix of arms, so one predict
+                # serves exactly one version
+                req.arm_version = arm_version
+                req.solo = True
             self.batcher.submit(req)
         except Exception:
             self.metrics.inc("rejected")
@@ -526,32 +596,28 @@ class ServingEngine:
         # model kwarg only when the batch carries one (legacy runner
         # fakes keep their run(batch) signature)
         mkw = {} if model is None else {"model": model}
-
-        def attempt_run(attempt: int):
-            if attempt:
-                self.metrics.inc("retried")
-            return self.runner.run(batch, **mkw)
-
+        # rollout split (ISSUE 17): a candidate-arm request is always
+        # solo, so the whole batch shares one arm_version
+        arm_ver = reqs[0].arm_version
+        served_version: Optional[int] = None
         try:
-            if self._routed:
-                # the pool retries/hedges/fails-over internally — the
-                # engine's own RetryPolicy would rerun an already-hedged
-                # batch; the tightest live deadline drives the hedge,
-                # and the lane tag tightens it further for interactive
-                deadlines = [r.deadline for r in reqs if r.deadline is not None]
-                rkw = dict(mkw)
-                if self._quarantine is not None:
-                    # containment: the pool sees member identities and a
-                    # shared budget view (one re-dispatch re-runs every
-                    # member, so one spend decrements each)
-                    rkw["digests"] = tuple(r.digest for r in reqs)
-                    rkw["budget"] = BatchBudget([r.budget for r in reqs])
-                out = self.runner.run(
-                    batch, deadline=min(deadlines) if deadlines else None,
-                    lane=lane, **rkw,
-                )
+            if arm_ver is not None and self.rollout is not None:
+                try:
+                    out = self.runner.run_version(
+                        batch, version=arm_ver, **mkw
+                    )
+                    served_version = arm_ver
+                except Exception as arm_e:  # noqa: BLE001 — any arm failure
+                    # the candidate arm failed (rolled back mid-flight,
+                    # or the candidate itself raised): count it as
+                    # evidence, then serve the request on the incumbent
+                    # — a rollout never loses a request
+                    self.rollout.note_arm_error(
+                        self._resolved_mid(model), arm_e
+                    )
+                    out = self._run_batch(batch, reqs, lane, mkw)
             else:
-                out = self.retry.run(attempt_run)
+                out = self._run_batch(batch, reqs, lane, mkw)
         except Exception as e:
             self._settle_failed(reqs, e)
             return
@@ -587,10 +653,19 @@ class ServingEngine:
                 self._resolve(r, exc=e)
                 continue
             if r.cache_key is not None and self.response_cache is not None:
-                # store only if the live version is STILL the one the key
-                # was minted against — a swap that landed mid-flight must
-                # not seed the cache with superseded-version results
-                if self._live_version(model) == r.cache_key[1]:
+                # store only if the version that SERVED is still the one
+                # the key was minted against — a swap that landed
+                # mid-flight, or a candidate arm that fell back to the
+                # incumbent, must not seed the cache under a version
+                # that did not produce these bytes
+                if arm_ver is not None:
+                    ok_put = (
+                        served_version is not None
+                        and served_version == r.cache_key[1]
+                    )
+                else:
+                    ok_put = self._live_version(model) == r.cache_key[1]
+                if ok_put:
                     self.response_cache.put(r.cache_key, dets)
             if self._quarantine is not None and r.digest is not None:
                 # a suspect that completes cleanly was an innocent
@@ -608,7 +683,54 @@ class ServingEngine:
             self.metrics.record_tenant(
                 r.tenant, e2e_s, queue_wait_s=r.picked_t - r.enqueue_t
             )
+            if self.rollout is not None:
+                mid_r = self._resolved_mid(model)
+                sv = (
+                    served_version if served_version is not None
+                    else self._live_version(model)
+                )
+                if mid_r is not None and sv is not None:
+                    self.metrics.record_version(mid_r, sv, e2e_s)
+                    self.rollout.note_serve(mid_r, sv, True, e2e_s)
+                if arm_ver is None and mid_r is not None:
+                    # shadow lane: mirror the incumbent's resolved
+                    # response for off-SLO candidate re-scoring (a full
+                    # queue drops, never blocks this thread)
+                    self.rollout.mirror(mid_r, r, dets)
             self._resolve(r, dets)
+
+    def _run_batch(
+        self, batch: Dict[str, np.ndarray], reqs: List[Request],
+        lane: str, mkw: Dict,
+    ):
+        """The incumbent (live-version) predict path: pool routing with
+        containment plumbing when routed, engine-side RetryPolicy when
+        not — factored out of :meth:`_complete` so the rollout's
+        candidate-arm fallback reuses it verbatim."""
+
+        def attempt_run(attempt: int):
+            if attempt:
+                self.metrics.inc("retried")
+            return self.runner.run(batch, **mkw)
+
+        if self._routed:
+            # the pool retries/hedges/fails-over internally — the
+            # engine's own RetryPolicy would rerun an already-hedged
+            # batch; the tightest live deadline drives the hedge,
+            # and the lane tag tightens it further for interactive
+            deadlines = [r.deadline for r in reqs if r.deadline is not None]
+            rkw = dict(mkw)
+            if self._quarantine is not None:
+                # containment: the pool sees member identities and a
+                # shared budget view (one re-dispatch re-runs every
+                # member, so one spend decrements each)
+                rkw["digests"] = tuple(r.digest for r in reqs)
+                rkw["budget"] = BatchBudget([r.budget for r in reqs])
+            return self.runner.run(
+                batch, deadline=min(deadlines) if deadlines else None,
+                lane=lane, **rkw,
+            )
+        return self.retry.run(attempt_run)
 
     # -------------------------------------------------- containment triage
     def _fail_one(self, req: Request,
@@ -702,11 +824,20 @@ class ServingEngine:
         """Operator command surface (``tools/serve.py`` wires it):
 
         * ``swap <model> <checkpoint_dir>`` — blocking hot-swap
+        * ``rollout <model> <checkpoint_dir>`` — blocking progressive
+          rollout (attaches a default-policy controller on first use)
+        * ``rollout status`` — rollout controller snapshot
         * ``models`` — registry snapshot
         """
         parts = line.split()
         if len(parts) == 3 and parts[0] == "swap":
             return self.swap(parts[1], parts[2], block=True)
+        if parts == ["rollout", "status"]:
+            return self.rollout.snapshot() if self.rollout else {}
+        if len(parts) == 3 and parts[0] == "rollout":
+            if self.rollout is None:
+                self.attach_rollout()
+            return self.rollout.start(parts[1], parts[2], block=True)
         if parts == ["models"]:
             reg = getattr(self.runner, "registry", None)
             return reg.snapshot() if reg is not None else {}
@@ -731,6 +862,8 @@ class ServingEngine:
             out["tenancy"] = self.tenants.snapshot()
         if self.autoscaler is not None:
             out["autoscaler"] = self.autoscaler.snapshot()
+        if self.rollout is not None:
+            out["rollout"] = self.rollout.snapshot()
         reg = getattr(self.runner, "registry", None)
         if reg is not None:
             out["registry"] = reg.snapshot()

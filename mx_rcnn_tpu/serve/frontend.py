@@ -28,7 +28,10 @@ Error codes: ``invalid_frame`` (length/JSON/shape/byte-count violations
 — rejected before an array is even built), ``unknown_tenant``,
 ``over_budget``, ``invalid_request`` (failed the quarantine admission
 gate), ``poison`` (quarantined digest), ``queue_full``, ``deadline``,
-``unknown_model``, ``exhausted``, ``engine_stopped``, ``error``.
+``unknown_model``, ``unknown_version`` (a rollout arm that rolled back
+mid-flight with no incumbent fallback), ``rollout_aborted`` (a blocking
+rollout command's verdict), ``exhausted``, ``engine_stopped``,
+``error``.
 
 The frame parser enforces byte-level bounds (``max_frame`` caps payload
 size so a hostile length prefix cannot balloon memory), then the decoded
@@ -76,6 +79,10 @@ def _classify(e: BaseException) -> str:
         return "over_budget"
     if "UnknownModel" in name:
         return "unknown_model"
+    if "UnknownVersion" in name:
+        return "unknown_version"
+    if "RolloutAborted" in name:
+        return "rollout_aborted"
     if "InvalidRequest" in name:
         return "invalid_request"
     if "Poison" in name:

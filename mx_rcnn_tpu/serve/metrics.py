@@ -251,6 +251,10 @@ class ServeMetrics:
         # that carried a tenant tag — the fairness-isolation evidence
         # (an aggressor's shed storm must not move the victim histogram)
         self.by_tenant: Dict[str, Dict] = {}
+        # per-version breakdown (ISSUE 17): populated only while a
+        # rollout controller is attached — the split-arm evidence
+        # (candidate p99 and error rate held against the incumbent's)
+        self.by_version: Dict[str, Dict] = {}
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -341,6 +345,23 @@ class ServeMetrics:
             if queue_wait_s is not None:
                 m["queue_wait"].record(queue_wait_s)
 
+    def record_version(self, model: str, version: int,
+                       e2e_s: Optional[float] = None,
+                       ok: bool = True) -> None:
+        """Per-(model, version) completion/failure counters + e2e
+        latency histogram — the rollout's per-arm partition (same shape
+        as :meth:`record_model`, keyed ``"<model>:v<version>"``)."""
+        key = f"{model}:v{int(version)}"
+        with self._lock:
+            m = self.by_version.get(key)
+            if m is None:
+                m = self.by_version[key] = {
+                    "completed": 0, "failed": 0, "e2e": LatencyHistogram(),
+                }
+            m["completed" if ok else "failed"] += 1
+        if ok and e2e_s is not None:
+            m["e2e"].record(e2e_s)
+
     def record_lane_batch(self, lane: str, real: int, slots: int) -> None:
         with self._lock:
             m = self._lane(lane)
@@ -411,6 +432,7 @@ class ServeMetrics:
             by_model = dict(self.by_model)
             by_lane = dict(self.by_lane)
             by_tenant = dict(self.by_tenant)
+            by_version = dict(self.by_version)
         if by_model:
             out["models"] = {
                 mid: {
@@ -448,6 +470,15 @@ class ServeMetrics:
                     "e2e": m["e2e"].snapshot(),
                 }
                 for t, m in by_tenant.items()
+            }
+        if by_version:
+            out["versions"] = {
+                k: {
+                    "completed": m["completed"],
+                    "failed": m["failed"],
+                    "e2e": m["e2e"].snapshot(),
+                }
+                for k, m in by_version.items()
             }
         if compile_cache is not None:
             out["compile"] = compile_cache.snapshot()

@@ -223,6 +223,7 @@ class QuarantineTable:
         self.probes_confirmed = 0
         self.probes_cleared = 0
         self.fastfail_hits = 0
+        self.suspects_dropped = 0  # ring-buffer evictions past max_suspects
 
     # ------------------------------------------------------------ internals
     def _purge_locked(self, now: float) -> None:
@@ -237,6 +238,7 @@ class QuarantineTable:
         while len(self._suspects) > self.max_suspects:
             oldest = min(self._suspects, key=lambda d: self._suspects[d].first_t)
             del self._suspects[oldest]
+            self.suspects_dropped += 1
 
     def _quarantine_locked(self, digest: str, reason: str, now: float) -> None:
         self._quarantined[digest] = (now + self.ttl_s, reason)
@@ -369,4 +371,5 @@ class QuarantineTable:
             "probes_confirmed": self.probes_confirmed,
             "probes_cleared": self.probes_cleared,
             "fastfail_hits": self.fastfail_hits,
+            "suspects_dropped": self.suspects_dropped,
         }

@@ -75,6 +75,11 @@ logger = logging.getLogger(__name__)
 #: constructor) and for requests that carry no model id
 DEFAULT_MODEL = "default"
 
+#: ring-buffer bound on each version's transition log — long-running
+#: rollout soaks cycle candidates through VERIFYING repeatedly, and an
+#: unbounded audit trail is a slow leak under a fleet's uptime
+TRANSITION_LOG_MAX = 64
+
 
 class RegistryError(RuntimeError):
     """Invalid registry operation (duplicate registration, no live
@@ -83,6 +88,12 @@ class RegistryError(RuntimeError):
 
 class UnknownModel(KeyError):
     """A request or swap referenced a model id nobody registered."""
+
+
+class UnknownVersion(KeyError):
+    """A request named a model version that is neither live nor staged —
+    a rollout arm already rolled back, or a version never warmed on this
+    target."""
 
 
 class SwapError(RuntimeError):
@@ -138,6 +149,7 @@ class ModelVersion:
         self.source = source
         self.state = state
         self.transitions: List[Dict[str, Any]] = []
+        self.transitions_dropped = 0
         self._t0 = time.monotonic()
 
     def snapshot(self) -> Dict[str, Any]:
@@ -149,6 +161,7 @@ class ModelVersion:
             "digest": (self.digest[:12] if self.digest else None),
             "released": self.params is None,
             "transitions": list(self.transitions),
+            "transitions_dropped": self.transitions_dropped,
         }
 
 
@@ -205,6 +218,9 @@ class ModelRegistry:
                     "reason": reason,
                 }
             )
+            while len(ver.transitions) > TRANSITION_LOG_MAX:
+                ver.transitions.pop(0)
+                ver.transitions_dropped += 1
         logger.info(
             "model %s v%d: %s -> %s (%s)",
             ver.model_id, ver.version, old.value, state.value, reason,

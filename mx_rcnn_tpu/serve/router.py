@@ -279,6 +279,38 @@ class ReplicaPool:
             if runner is not None and hasattr(runner, "discard_version"):
                 runner.discard_version(model, version)
 
+    def run_version(self, batch, model=None, version=None):
+        """Blocking forward through an explicit version on one routable
+        replica (least-loaded with affinity, same policy as live
+        routing) — the rollout split/shadow path.  A replica that has
+        not staged the version (mid-recovery rebuild) is skipped;
+        :class:`~mx_rcnn_tpu.serve.registry.UnknownVersion` propagates
+        only when NO routable replica holds it (the arm rolled back)."""
+        from mx_rcnn_tpu.serve.registry import UnknownVersion
+
+        bucket = tuple(batch["images"].shape[1:3])
+        tried: list = []
+        last: Optional[BaseException] = None
+        while True:
+            r = self._pick(bucket, exclude=tuple(tried), model=model)
+            if r is None:
+                break
+            tried.append(r.index)
+            runner = r.runner
+            if runner is None or not hasattr(runner, "run_version"):
+                continue
+            try:
+                return runner.run_version(batch, model=model, version=version)
+            except UnknownVersion as e:
+                last = e
+                continue
+        if last is not None:
+            raise last
+        raise NoHealthyReplica(
+            f"no routable replica for version-pinned run (model={model!r}, "
+            f"version={version!r})"
+        )
+
     # ------------------------------------------------------- routing
     def healthy_fraction(self) -> float:
         replicas = self.replicas  # one stable copy-on-write read

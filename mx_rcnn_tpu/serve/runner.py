@@ -946,6 +946,44 @@ class ServeRunner:
         mid = self.default_model if model is None else model
         self._staged.pop((mid, int(version)), None)
 
+    def run_version(
+        self,
+        batch: Dict[str, np.ndarray],
+        model: Optional[str] = None,
+        version: Optional[int] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Blocking forward through an EXPLICIT version: the live slot
+        when ``version`` is the live one (or None), else the staged
+        candidate tree parked by :meth:`warm_version` — the rollout
+        split/shadow predict path.  Params are a jit argument
+        (:meth:`Predictor.predict_with`), so a candidate with the same
+        tree structure reuses the live compiled executables: a split
+        adds zero jit signatures.  Raises
+        :class:`~mx_rcnn_tpu.serve.registry.UnknownVersion` when the
+        version is neither live nor staged (a rolled-back arm) — the
+        engine's cue to fall back to the incumbent."""
+        from mx_rcnn_tpu.serve.registry import UnknownVersion
+
+        mid = self.default_model if model is None else model
+        slot = self._slot(mid)
+        self._sync(slot)
+        if version is None or int(version) == slot.version:
+            return self.run(batch, model=mid)
+        placed = self._staged.get((mid, int(version)))
+        if placed is None:
+            raise UnknownVersion(
+                f"model {mid!r} v{int(version)} is neither live "
+                f"(v{slot.version}) nor staged on this runner"
+            )
+        sig = self._signature(batch, mid)
+        self.compile_cache.record(sig)
+        if self.layout_feed:
+            batch = self.stage(batch, mid)
+        self.served_buckets.setdefault(mid, set()).add(
+            tuple(batch["images"].shape[1:3])
+        )
+        return slot.predictor.predict_with(placed, batch)
+
     # ---- per-image postprocess
     def detections_for(
         self,

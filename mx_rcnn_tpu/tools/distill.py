@@ -1,0 +1,255 @@
+"""Distillation harvest: served detections → training records → a
+rollout candidate (the serve→train→serve loop, ISSUE 17).
+
+The upstream paper's alternate-training heritage (PAPER.md §1) means
+the serving family and the fine-tune family share data by
+construction, so responses the fleet already computed are free
+supervision: :func:`harvest` converts per-class detection lists into
+``data/synthetic.py``-schema roidb records (``synthetic://`` URIs, so
+the existing loader renders them deterministically — no image bytes
+ever stored), :func:`write_records`/:func:`read_records` round-trip
+them as JSONL, and :func:`fine_tune` runs them through the existing
+elastic trainer (``core/fit.py``) and emits a checkpoint whose tree
+structure matches the SERVE-time init — exactly what the rollout's
+structure gate demands, so the output feeds straight into
+``RolloutController.start`` (or ``engine.admin("rollout ...")``).
+
+CLI::
+
+  # response report (loadgen --out JSON with _results) → records
+  python -m mx_rcnn_tpu.tools.distill --report serve_report.json \
+      --records distilled.jsonl
+
+  # records → fine-tuned rollout candidate checkpoint
+  python -m mx_rcnn_tpu.tools.distill --records distilled.jsonl \
+      --fit --network resnet50 --steps 4 --ckpt-out /tmp/distilled
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: smallest box side (px) worth training on — sub-peephole detections
+#: are usually threshold noise, and the synthetic renderer degenerates
+MIN_BOX_SIDE = 8.0
+
+
+def record_from_detections(
+    dets: Sequence,
+    height: int,
+    width: int,
+    *,
+    index: int,
+    min_score: float = 0.5,
+    seed: int = 0,
+    num_classes: Optional[int] = None,
+) -> Optional[Dict[str, Any]]:
+    """One served response → one ``data/synthetic.py``-schema roidb
+    record, or None when nothing confident survives.
+
+    ``dets`` is the serve stack's per-class list (``[None, (n1,5),
+    ...]``, boxes in ORIGINAL image coordinates).  Detections below
+    ``min_score`` are dropped (don't train on threshold noise), boxes
+    are clipped into the image and must keep ``MIN_BOX_SIDE``; classes
+    at or above ``num_classes`` (when given — the fine-tune config's
+    class count) are dropped rather than remapped."""
+    boxes: List[List[float]] = []
+    classes: List[int] = []
+    for j, arr in enumerate(dets or []):
+        if j == 0 or arr is None:
+            continue
+        a = np.asarray(arr, np.float32)
+        if a.ndim != 2 or a.shape[1] < 5:
+            continue
+        if num_classes is not None and j >= num_classes:
+            continue
+        for row in a[a[:, 4] >= min_score]:
+            x1 = float(np.clip(row[0], 0, width - 1))
+            y1 = float(np.clip(row[1], 0, height - 1))
+            x2 = float(np.clip(row[2], 0, width - 1))
+            y2 = float(np.clip(row[3], 0, height - 1))
+            if x2 - x1 < MIN_BOX_SIDE or y2 - y1 < MIN_BOX_SIDE:
+                continue
+            boxes.append([x1, y1, x2, y2])
+            classes.append(j)
+    if not boxes:
+        return None
+    return {
+        "image": f"synthetic://{index}",
+        "height": int(height),
+        "width": int(width),
+        "boxes": np.asarray(boxes, np.float32),
+        "gt_classes": np.asarray(classes, np.int32),
+        "flipped": False,
+        "synthetic_seed": int(seed) + 1000 + int(index),
+    }
+
+
+def harvest(
+    responses: Iterable[Tuple[Sequence, Tuple[int, int]]],
+    min_score: float = 0.5,
+    seed: int = 0,
+    num_classes: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """``(cls_dets, (height, width))`` pairs — e.g. zipped out of a
+    loadgen report's ``_results`` — → the harvested roidb."""
+    records = []
+    for i, (dets, hw) in enumerate(responses):
+        rec = record_from_detections(
+            dets, hw[0], hw[1], index=i, min_score=min_score, seed=seed,
+            num_classes=num_classes,
+        )
+        if rec is not None:
+            records.append(rec)
+    return records
+
+
+# ------------------------------------------------------------------ JSONL
+def write_records(records: Sequence[Dict[str, Any]], path: str) -> int:
+    """Records → JSONL (numpy arrays as nested lists); returns count."""
+    with open(path, "w") as f:
+        for rec in records:
+            doc = dict(rec)
+            doc["boxes"] = np.asarray(rec["boxes"]).tolist()
+            doc["gt_classes"] = np.asarray(rec["gt_classes"]).tolist()
+            f.write(json.dumps(doc) + "\n")
+    return len(records)
+
+
+def read_records(path: str) -> List[Dict[str, Any]]:
+    """JSONL → records with the exact loader dtypes
+    (float32 boxes, int32 classes) — byte-compatible with
+    :meth:`~mx_rcnn_tpu.data.synthetic.SyntheticDataset.gt_roidb`."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            doc["boxes"] = np.asarray(doc["boxes"], np.float32)
+            doc["gt_classes"] = np.asarray(doc["gt_classes"], np.int32)
+            records.append(doc)
+    return records
+
+
+# -------------------------------------------------------------- fine-tune
+def fine_tune(
+    records: Sequence[Dict[str, Any]],
+    network: str = "resnet50",
+    steps: int = 2,
+    seed: int = 0,
+    out_dir: Optional[str] = None,
+    init_donor: Optional[Dict] = None,
+) -> str:
+    """Fine-tune on harvested records and save a rollout-ready
+    checkpoint; returns its path.
+
+    The trainer inits with ``train=True`` (sampling heads live), so the
+    fitted tree's structure differs from the serve-time init.  The
+    rollout/swap structure gate compares against the LIVE version's
+    serve tree, so the fitted subtrees are merged back into a fresh
+    ``train=False`` init before saving — the emitted checkpoint loads
+    with zero recompiles."""
+    import tempfile
+
+    import jax
+
+    from mx_rcnn_tpu.core.checkpoint import save_checkpoint
+    from mx_rcnn_tpu.core.fit import fit, merge_params
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.tools.serve import small_config
+
+    if not records:
+        raise ValueError("no harvested records to fine-tune on")
+    cfg = small_config(network)
+    model = build_model(cfg)
+    fitted = fit(
+        model, cfg, list(records), epochs=1, seed=seed,
+        max_steps=max(1, int(steps)), frequent=1,
+        init_donor=init_donor,
+    )
+    h, w = cfg.SHAPE_BUCKETS[0]
+    serve_init = model.init(
+        {"params": jax.random.key(seed)},
+        np.zeros((1, h, w, 3), np.float32),
+        np.array([[h, w, 1.0]], np.float32),
+        train=False,
+    )["params"]
+    final = merge_params(serve_init, fitted)
+    if out_dir is None:
+        out_dir = tempfile.mkdtemp(prefix="distill_")
+    return save_checkpoint(
+        os.path.join(out_dir, "distilled"), {"params": final}, 1
+    )
+
+
+# -------------------------------------------------------------------- CLI
+def records_from_report(
+    path: str,
+    min_score: float = 0.5,
+    seed: int = 0,
+    num_classes: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """A loadgen report JSON (``collect=True`` → ``_results`` +
+    per-request sizes under ``sizes``) → harvested records."""
+    with open(path) as f:
+        report = json.load(f)
+    results = report.get("_results") or {}
+    sizes = report.get("sizes") or {}
+    responses = []
+    for key in sorted(results, key=lambda k: int(k)):
+        kind, dets = results[key]
+        if kind != "ok":
+            continue
+        hw = sizes.get(str(key)) or sizes.get(int(key)) or (480, 640)
+        responses.append((dets, tuple(hw)))
+    return harvest(
+        responses, min_score=min_score, seed=seed, num_classes=num_classes
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="distill",
+        description="served detections -> training records -> candidate",
+    )
+    ap.add_argument("--report", help="loadgen report JSON to harvest")
+    ap.add_argument("--records", required=True,
+                    help="records JSONL (written with --report, else read)")
+    ap.add_argument("--min-score", type=float, default=0.5)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fit", action="store_true",
+                    help="fine-tune on the records and save a checkpoint")
+    ap.add_argument("--network", default="resnet50")
+    ap.add_argument("--steps", type=int, default=2)
+    ap.add_argument("--ckpt-out", default=None)
+    args = ap.parse_args(argv)
+
+    if args.report:
+        records = records_from_report(
+            args.report, min_score=args.min_score, seed=args.seed
+        )
+        n = write_records(records, args.records)
+        print(f"harvested {n} records -> {args.records}")
+    else:
+        records = read_records(args.records)
+    if args.fit:
+        path = fine_tune(
+            records, network=args.network, steps=args.steps,
+            seed=args.seed, out_dir=args.ckpt_out,
+        )
+        print(f"candidate checkpoint -> {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1159,3 +1159,177 @@ def test_mask_artifact_schema_guard(tmp_path):
     assert "'rle_byte_identical' missing" in errs
     assert "fetch_bytes incomplete" in errs
     assert "no record metric 'serve_mask_p50_ms*'" in errs
+
+
+# R4 against the ISSUE 17 rollout shape: the controller lock guards
+# only the split/shadow tables — device work (shadow scoring, warm
+# placement) and registry calls happen OUTSIDE it.  A controller that
+# scores under its own lock, or a registry→runner→registry call chain
+# that closes the lock cycle the promote path walks, is exactly what
+# R4 must flag.
+
+R4_ROLLOUT_BAD = """
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+import jax
+
+class RolloutController:
+    def __init__(self):
+        self._lock = make_lock("RolloutController._lock")
+        self.registry = None
+
+    def score_shadow(self, tree):
+        with self._lock:
+            return jax.device_put(tree)
+
+class ModelRegistry:
+    def __init__(self):
+        self._lock = make_lock("ModelRegistry._lock")
+        self.runner = None
+
+    def commit(self):
+        with self._lock:
+            return self.runner.sync()
+
+class ServeRunner:
+    def __init__(self):
+        self._lock = make_lock("ServeRunner._lock")
+        self.registry = None
+
+    def sync(self):
+        with self._lock:
+            return self.registry.commit()
+"""
+
+R4_ROLLOUT_GOOD = """
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+import jax
+
+class RolloutController:
+    def __init__(self):
+        self._lock = make_lock("RolloutController._lock")
+        self.registry = None
+        self._split = {}
+
+    def close_tables(self):
+        with self._lock:
+            self._split.clear()
+
+    def promote(self):
+        self.close_tables()
+        self.registry.commit()
+
+    def score_shadow(self, tree):
+        placed = jax.device_put(tree)
+        with self._lock:
+            self.scored = 1
+        return placed
+
+class ModelRegistry:
+    def __init__(self):
+        self._lock = make_lock("ModelRegistry._lock")
+
+    def commit(self):
+        with self._lock:
+            return True
+"""
+
+
+def test_r4_fires_on_rollout_device_work_under_controller_lock():
+    fs = run_rule(R4_ROLLOUT_BAD, LockOrder(),
+                  path="mx_rcnn_tpu/serve/rollout.py")
+    assert any(
+        f.scope == "RolloutController.score_shadow" and "device" in f.message
+        for f in fs
+    )
+    assert any("cycle" in f.message for f in fs)
+
+
+def test_r4_silent_on_rollout_tables_then_registry_order():
+    assert run_rule(R4_ROLLOUT_GOOD, LockOrder(),
+                    path="mx_rcnn_tpu/serve/rollout.py") == []
+
+
+# R5 against the ISSUE 17 shadow lane: the mirror queue is a take
+# source; popping an item under the condition and then bailing on the
+# stop flag without scoring it silently drops a comparison the
+# promote/rollback verdict was waiting on.  The shipped worker checks
+# stop-and-empty BEFORE the pop, so every popped item reaches the
+# scorer on every path.
+
+R5_SHADOW_BAD = """
+class ShadowWorker:
+    def loop(self):
+        while True:
+            with self._cond:
+                item = self._shadow_queue.popleft()
+            if self._stop:
+                return
+            self._score(item)
+"""
+
+R5_SHADOW_GOOD = """
+class ShadowWorker:
+    def loop(self):
+        while True:
+            with self._cond:
+                while not self._shadow_queue and not self._stop:
+                    self._cond.wait(0.05)
+                if not self._shadow_queue and self._stop:
+                    return
+                item = self._shadow_queue.popleft()
+            self._score(item)
+"""
+
+
+def test_r5_fires_on_droppable_shadow_item():
+    fs = run_rule(R5_SHADOW_BAD, ExactlyOnce(),
+                  path="mx_rcnn_tpu/serve/rollout.py")
+    assert len(fs) == 1 and "`item`" in fs[0].message
+
+
+def test_r5_silent_on_pop_after_stop_check():
+    assert run_rule(R5_SHADOW_GOOD, ExactlyOnce(),
+                    path="mx_rcnn_tpu/serve/rollout.py") == []
+
+
+def test_rollout_artifact_schema_guard(tmp_path):
+    """BENCH_rollout_cpu.json must carry the five ISSUE 17 closure
+    claims — all true — plus the shadow divergence evidence and the
+    rollout metric records."""
+    claims = {
+        "zero_lost_requests": True,
+        "control_arm_byte_identical": True,
+        "divergence_auto_rollback": True,
+        "zero_steady_state_recompiles": True,
+        "closed_loop_promoted": True,
+    }
+    good = {
+        "records": [
+            {"metric": m, "value": 1}
+            for m in ("rollout_split_served",
+                      "rollout_shadow_compared",
+                      "rollout_promote_lost_requests",
+                      "rollout_rollback_incumbent_identical",
+                      "rollout_steady_state_recompiles",
+                      "rollout_distill_records",
+                      "rollout_loop_promoted_version")
+        ],
+        "report": {
+            "claims": dict(claims),
+            "divergence": {"compared": 12, "max_box_delta_px": 0.002},
+        },
+    }
+    art = tmp_path / "BENCH_rollout_cpu.json"
+    art.write_text(json.dumps(good))
+    assert check_bench_artifacts(tmp_path) == []
+
+    good["report"]["claims"]["divergence_auto_rollback"] = False
+    del good["report"]["claims"]["closed_loop_promoted"]
+    del good["report"]["divergence"]["compared"]
+    good["records"] = good["records"][1:]
+    art.write_text(json.dumps(good))
+    errs = " | ".join(check_bench_artifacts(tmp_path))
+    assert "'divergence_auto_rollback' not true" in errs
+    assert "'closed_loop_promoted' missing" in errs
+    assert "divergence incomplete" in errs
+    assert "no record metric 'rollout_split_served*'" in errs

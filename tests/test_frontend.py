@@ -407,9 +407,12 @@ class TestFrontendWire:
         good_header(shape=[2, 2, 4]) + b"\x00" * 16,
         good_header(shape=[0, 2, 3]),
         good_header() + b"\x00" * 5,  # byte count != 2*2*3
+        good_header(shape=[2, -1, 3]) + b"\x00" * 12,
+        good_header(shape="2x2x3") + b"\x00" * 12,
     ], ids=["no-newline", "bad-json", "non-dict", "tenant-null",
             "tenant-empty", "tenant-nonstring", "bad-dtype", "shape-2d",
-            "shape-not-rgb", "shape-zero", "byte-mismatch"])
+            "shape-not-rgb", "shape-zero", "byte-mismatch",
+            "shape-negative", "shape-nonlist"])
     def test_malformed_frame_matrix(self, served_engine, payload):
         _, fe = served_engine
         with FrontendClient("127.0.0.1", fe.port) as cli:
@@ -464,3 +467,52 @@ class TestFrontendWire:
         snap = fe.snapshot()
         assert snap["accepted"] == 1
         assert snap["frames"] == 1
+
+
+class TestRolloutErrorTaxonomy:
+    """ISSUE 17 satellite: rollout-layer failures must surface as typed
+    wire codes, never as a generic ``internal``."""
+
+    def test_classify_maps_rollout_exceptions(self):
+        from mx_rcnn_tpu.serve.frontend import _classify
+        from mx_rcnn_tpu.serve.registry import UnknownVersion
+        from mx_rcnn_tpu.serve.rollout import RolloutAborted
+
+        assert _classify(UnknownVersion("det v9 neither live nor staged")) \
+            == "unknown_version"
+        assert _classify(
+            RolloutAborted("evaluate", RuntimeError("box delta 9.3px"))
+        ) == "rollout_aborted"
+        # taxonomy is still closed: unrelated errors stay generic
+        assert _classify(RuntimeError("boom")) == "error"
+
+    @pytest.mark.parametrize("make_exc,code", [
+        (lambda: __import__(
+            "mx_rcnn_tpu.serve.registry", fromlist=["UnknownVersion"]
+        ).UnknownVersion("det v7"), "unknown_version"),
+        (lambda: __import__(
+            "mx_rcnn_tpu.serve.rollout", fromlist=["RolloutAborted"]
+        ).RolloutAborted("evaluate", RuntimeError("bound tripped")),
+         "rollout_aborted"),
+    ], ids=["unknown-version", "rollout-aborted"])
+    def test_rollout_failures_are_typed_on_the_wire(
+            self, served_engine, monkeypatch, make_exc, code):
+        engine, fe = served_engine
+        from concurrent.futures import Future
+
+        def failing_submit(*args, **kwargs):
+            fut = Future()
+            fut.set_exception(make_exc())
+            return fut
+
+        monkeypatch.setattr(engine, "submit", failing_submit)
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            resp = cli.request(image(6), tenant="acme")
+        assert resp["ok"] is False
+        assert resp["error"] == code
+        assert fe.errors[code] == 1
+        # the connection survives a typed failure: next request works
+        monkeypatch.undo()
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            again = cli.request(image(7), tenant="acme")
+        assert again["ok"]
