@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault serve-mask serve-scale swap rollout slo poison pipeline elastic chaos integration-gate clean-native
+.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault serve-mask serve-scale swap rollout cascade slo poison pipeline elastic chaos integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -142,6 +142,16 @@ rollout:
 	JAX_PLATFORMS=cpu $(PY) bench.py --rollout --serve_requests 24 \
 	      --serve_concurrency 6 --serve_max_batch 2 \
 	      --out BENCH_rollout_cpu.json
+
+# compression ladder + confidence-gated cascade bench (ISSUE 18):
+# escalation-threshold sweep tracing cost-per-image vs matched
+# accuracy (cheap-first serving with flagship escalation on doubt),
+# 100%-escalation byte-identity control arm, per-rung parity matrix
+# ({box,mask} x {f32,bf16,int8} on real tiny models) and int8
+# compression stats; emits JSON lines + the BENCH_cascade_cpu.json
+# artifact, which `make check`'s lint artifact-parse pass then guards
+cascade:
+	JAX_PLATFORMS=cpu $(PY) bench.py --cascade --out BENCH_cascade_cpu.json
 
 # SLO-tier serving bench (ISSUE 11): sparse interactive probes against
 # a saturating bulk backlog, single-lane baseline vs two-lane scheduling

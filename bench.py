@@ -1014,7 +1014,9 @@ def bench_serve_slo(
         registry=registry, max_batch=max_batch, precision="bfloat16"
     )
     runner_bf16.warmup()
-    parity = dict(runner_bf16.parity[registry.default_model])
+    parity = dict(
+        runner_bf16.parity[f"{registry.default_model}:bf16"]
+    )
 
     def service_s(r):
         req = r.make_request(synthetic_image(7, *probe_hw, seed=3))
@@ -2924,6 +2926,342 @@ def bench_serve_scale(
     return records, report
 
 
+def _cascade_tiny_cfg(network: str):
+    """One-bucket config for the per-rung parity matrix — the smallest
+    geometry each real model compiles AND executes at, so six warmups
+    (2 families x 3 precisions) stay CPU-tractable.  The mask-FPN
+    family takes 96x96: its batch-1 64x64 serve graph trips a oneDNN
+    convolution-primitive crash on this host, 96x96 does not."""
+    from mx_rcnn_tpu.config import generate_config
+
+    cfg = generate_config(network, "PascalVOC")
+    bucket = (96, 96) if cfg.network.USE_MASK else (64, 64)
+    net_over = {"FIXED_PARAMS": ()}
+    if not cfg.network.USE_FPN:
+        net_over["ANCHOR_SCALES"] = (2, 4, 8)
+    if cfg.network.depth > 50 and cfg.network.name == "resnet":
+        net_over["depth"] = 50
+    test_over = {
+        "RPN_PRE_NMS_TOP_N": 100,
+        "RPN_POST_NMS_TOP_N": 16,
+        "SCORE_THRESH": 0.05,
+    }
+    if cfg.network.USE_MASK:
+        test_over.update(DET_PER_CLASS=8, MAX_PER_IMAGE=8)
+    return cfg.replace(
+        SHAPE_BUCKETS=(bucket,),
+        network=dataclasses.replace(cfg.network, **net_over),
+        dataset=dataclasses.replace(
+            cfg.dataset, NUM_CLASSES=4, SCALES=((bucket[0] - 16, bucket[0]),)
+        ),
+        TEST=dataclasses.replace(cfg.TEST, **test_over),
+    )
+
+
+def _cascade_rung_matrix() -> tuple:
+    """Per-rung parity matrix: {box, mask} x {f32, bf16, int8} on REAL
+    tiny models.  bf16/int8 warmups run the f32 detection-parity gate
+    (mask parity included for the mask family) and raise on drift, so
+    every row returned here passed the same gate serving would; f32
+    rows are the reference rung (trivially ok, nothing to check).
+    Also proves zero post-warmup compile-miss growth per rung."""
+    import jax
+
+    from mx_rcnn_tpu.core.quantize import quantization_stats
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.serve.loadgen import synthetic_image
+    from mx_rcnn_tpu.serve.registry import ModelRegistry
+    from mx_rcnn_tpu.serve.runner import ServeRunner
+
+    matrix = []
+    compression = {}
+    steady_misses = 0
+    for family, network in (("box", "resnet50"),
+                            ("mask", "mask_resnet_fpn")):
+        cfg = _cascade_tiny_cfg(network)
+        h, w = cfg.SHAPE_BUCKETS[0]
+        model = build_model(cfg)
+        params = model.init(
+            {"params": jax.random.key(0)},
+            np.zeros((1, h, w, 3), np.float32),
+            np.array([[h, w, 1.0]], np.float32),
+            train=False,
+        )["params"]
+        # keep the raw random init: its saturated scores rank proposals
+        # with wide margins, so the parity gate measures numeric drift,
+        # not NMS tie-flips between near-equal scores
+        registry = ModelRegistry()
+        registry.register(family, model, cfg, params)
+        im = synthetic_image(17, h - 8, w, seed=4)
+        for precision in ("f32", "bfloat16", "int8"):
+            runner = ServeRunner(
+                registry=registry, max_batch=1, deterministic=True,
+                precision=precision,
+            )
+            runner.warmup()
+            tag = runner._precision_for(family)
+            row = {"family": family, "precision": tag}
+            report = runner.parity.get(f"{family}:{tag}")
+            if report is None:  # the f32 reference rung
+                row.update(ok=True, checked=False)
+            else:
+                row.update(
+                    ok=bool(report["ok"]), checked=bool(report["checked"]),
+                    max_box_delta_px=report["max_box_delta_px"],
+                    max_score_delta=report["max_score_delta"],
+                    unmatched_confident=report["unmatched_confident"],
+                )
+            # post-warmup serving must not add a single jit signature
+            misses0 = runner.compile_cache.misses
+            runner.run(runner.assemble([runner.make_request(im)]))
+            steady_misses += runner.compile_cache.misses - misses0
+            matrix.append(row)
+            if tag == "int8":
+                compression[family] = quantization_stats(
+                    registry.live(family).params,
+                    registry.quantized_tree(family),
+                )
+    return matrix, compression, steady_misses
+
+
+def bench_cascade(requests: int = 80, hard_pct: float = 30.0) -> tuple:
+    """Compression ladder + confidence-gated cascade (ISSUE 18).
+
+    Two legs:
+
+    1. **threshold sweep** — a two-family registry (cheap/flagship)
+       behind the REAL engine + cascade router, with a stub predict
+       whose per-batch device cost is MODELED (booked into the
+       runner's ``device_ms_by_model`` counters, no sleeps): cheap 15
+       ms/image, flagship 60 ms/image.  ``hard_pct`` of images are
+       "hard": the cheap family answers them wrong and scores them low
+       (0.3 vs 0.9), the flagship always answers right.  Sweeping the
+       escalation threshold traces the cost-per-image vs accuracy
+       curve: never-escalate (cheapest, wrong on hard images),
+       escalate-on-doubt (matched accuracy at a fraction of the cost —
+       THE claim), and 100% escalation (the byte-identity control arm
+       vs flagship-only serving).
+
+    2. **per-rung parity matrix** — {box, mask} x {f32, bf16, int8} on
+       real tiny models: every reduced-precision rung passes the same
+       f32 detection/mask-parity gate serving enforces, int8
+       compression is ~4x, and no rung adds a post-warmup compile.
+    """
+    from mx_rcnn_tpu.serve.batcher import Request
+    from mx_rcnn_tpu.serve.buckets import BucketLadder, CompileCache
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.registry import ModelRegistry
+
+    CHEAP_MS, FLAG_MS = 15.0, 60.0
+
+    class _CascadeStubRunner:
+        """Registry-backed stub: detections are a pure function of the
+        image index (encoded in the corner pixel) and the family;
+        device cost per batch is booked, not slept."""
+
+        def __init__(self, registry):
+            self.registry = registry
+            self.default_model = registry.default_model
+            self.ladder = BucketLadder(((32, 32),))
+            self.max_batch = 1  # exact per-request cost attribution
+            self.cfg = None
+            self.compile_cache = CompileCache()
+            self.device_ms_total = 0.0
+            self.device_ms_by_model = {}
+
+        def warmup(self) -> int:
+            for mid in self.registry.model_ids():
+                self.compile_cache.record((mid, (1, 32, 32, 3), "f32"))
+            return self.compile_cache.misses
+
+        def make_request(self, im, deadline=None, model=None) -> Request:
+            h, w = im.shape[:2]
+            bh, bw = self.ladder.select(h, w)
+            canvas = np.zeros((bh, bw, 3), np.float32)
+            canvas[:h, :w] = im
+            return Request(
+                image=canvas,
+                im_info=np.array([h, w, 1.0], np.float32),
+                orig_hw=(h, w),
+                bucket=(bh, bw),
+                deadline=deadline,
+                model=model,
+            )
+
+        def assemble(self, requests_):
+            return {"images": np.stack([r.image for r in requests_])}
+
+        def run(self, batch, model=None):
+            mid = model or self.default_model
+            self.compile_cache.record(
+                (mid, batch["images"].shape, "f32")
+            )
+            cost = (CHEAP_MS if mid == "cheap" else FLAG_MS) * len(
+                batch["images"]
+            )
+            self.device_ms_total += cost
+            self.device_ms_by_model[mid] = (
+                self.device_ms_by_model.get(mid, 0.0) + cost
+            )
+            # the image index rides the corner pixel (see _image)
+            idx = np.round(batch["images"][:, 0, 0, 0]).astype(int)
+            return {"idx": idx, "mid": mid}
+
+        def detections_for(self, out, batch, index, orig_hw=None,
+                           thresh=None, model=None):
+            i = int(out["idx"][index])
+            hard = _is_hard(i)
+            gt_x = float(5 + (i % 13))
+            if out["mid"] == "flag":
+                x, score = gt_x, 0.95
+            else:
+                x = gt_x + (20.0 if hard else 0.0)  # wrong box when hard
+                score = 0.3 if hard else 0.9
+            return [
+                None,
+                np.array([[x, 2.0, x + 10.0, 12.0, score]], np.float32),
+            ]
+
+    def _is_hard(i: int) -> bool:
+        return (i % 100) < hard_pct
+
+    def _image(i: int) -> np.ndarray:
+        im = np.full((24, 24, 3), 0.5, np.float32)
+        im[0, 0, 0] = float(i)  # index channel the stub decodes
+        return im
+
+    def _accuracy(dets_list) -> float:
+        good = 0
+        for i, dets in enumerate(dets_list):
+            gt_x = float(5 + (i % 13))
+            good += int(abs(float(dets[1][0, 0]) - gt_x) < 1.0)
+        return good / len(dets_list)
+
+    def _run_leg(min_score):
+        reg = ModelRegistry()
+        reg.register("cheap", model=None, cfg=None, params={"w": 1})
+        reg.register("flag", model=None, cfg=None, params={"w": 2})
+        runner = _CascadeStubRunner(reg)
+        eng = ServingEngine(runner, max_linger=0.0, max_queue=256)
+        with eng:
+            if min_score is not None:
+                eng.attach_cascade({
+                    "cheap": "cheap", "flagship": "flag",
+                    "min_score": min_score,
+                })
+            warm_misses = runner.compile_cache.misses
+            dets = [eng.submit(_image(i), model="flag").result(30)
+                    for i in range(requests)]
+            snap = eng.snapshot()
+        casc = snap.get("cascade", {})
+        return {
+            "min_score": min_score,
+            "accuracy": round(_accuracy(dets), 4),
+            "cost_ms_per_image": round(
+                runner.device_ms_total / requests, 3
+            ),
+            "device_ms_by_model": {
+                k: round(v, 1)
+                for k, v in runner.device_ms_by_model.items()
+            },
+            "escalations": casc.get("escalations", 0),
+            "escalation_rate": casc.get("escalation_rate", 0.0),
+            "first_pass_sufficient": casc.get("first_pass_sufficient", 0),
+            "steady_state_compile_misses":
+                runner.compile_cache.misses - warm_misses,
+            "completed": snap["requests"]["completed"],
+        }, [d[1].tobytes() for d in dets]
+
+    flagship_only, base_bytes = _run_leg(None)
+    sweep = []
+    full_bytes = None
+    for thresh in (0.0, 0.6, 1.01):
+        leg, leg_bytes = _run_leg(thresh)
+        sweep.append(leg)
+        if thresh == 1.01:
+            full_bytes = leg_bytes
+    # best rung: cheapest sweep point within 1% of flagship accuracy
+    matched = [s for s in sweep
+               if s["accuracy"] >= flagship_only["accuracy"] - 0.01]
+    best = min(matched, key=lambda s: s["cost_ms_per_image"])
+    cost_reduction = round(
+        flagship_only["cost_ms_per_image"] / best["cost_ms_per_image"], 2
+    )
+    zero_recompiles = (
+        flagship_only["steady_state_compile_misses"] == 0
+        and all(s["steady_state_compile_misses"] == 0 for s in sweep)
+    )
+
+    matrix, compression, rung_misses = _cascade_rung_matrix()
+    int8_rows = [r for r in matrix if r["precision"] == "int8"]
+    bf16_rows = [r for r in matrix if r["precision"] == "bf16"]
+    claims = {
+        "cost_reduction_ge_1p3x_at_matched_accuracy": bool(
+            cost_reduction >= 1.3
+        ),
+        "full_escalation_byte_identical": bool(full_bytes == base_bytes),
+        "zero_steady_state_recompiles": bool(
+            zero_recompiles and rung_misses == 0
+        ),
+        "int8_parity_ok_box_and_mask": bool(
+            len(int8_rows) == 2
+            and all(r["ok"] and r["checked"] for r in int8_rows)
+        ),
+        "bf16_parity_ok_box_and_mask": bool(
+            len(bf16_rows) == 2
+            and all(r["ok"] and r["checked"] for r in bf16_rows)
+        ),
+    }
+    report = {
+        "claims": claims,
+        "config": {
+            "requests": requests,
+            "hard_pct": hard_pct,
+            "cheap_ms_per_image": CHEAP_MS,
+            "flagship_ms_per_image": FLAG_MS,
+        },
+        "flagship_only": flagship_only,
+        "sweep": sweep,
+        "best": dict(best, cost_reduction_x=cost_reduction),
+        "parity_matrix": matrix,
+        "int8_compression": compression,
+    }
+    records = [
+        {"metric": "serve_cascade_cost_ms_per_image_flagship_only",
+         "value": flagship_only["cost_ms_per_image"], "unit": "ms",
+         "vs_baseline": None},
+        {"metric": "serve_cascade_cost_ms_per_image_matched",
+         "value": best["cost_ms_per_image"], "unit": "ms",
+         "vs_baseline": None},
+        {"metric": "serve_cascade_cost_reduction_x",
+         "value": cost_reduction, "unit": "x", "vs_baseline": None},
+        {"metric": "serve_cascade_accuracy_flagship_only",
+         "value": flagship_only["accuracy"], "unit": "fraction",
+         "vs_baseline": None},
+        {"metric": "serve_cascade_accuracy_matched",
+         "value": best["accuracy"], "unit": "fraction",
+         "vs_baseline": None},
+        {"metric": "serve_cascade_escalation_rate_matched",
+         "value": best["escalation_rate"], "unit": "fraction",
+         "vs_baseline": None},
+        {"metric": "serve_cascade_parity_rungs_ok",
+         "value": sum(int(r["ok"]) for r in matrix), "unit": "rungs",
+         "vs_baseline": None},
+        {"metric": "serve_cascade_int8_compression_x_box",
+         "value": compression["box"]["compression_x"], "unit": "x",
+         "vs_baseline": None},
+        {"metric": "serve_cascade_int8_compression_x_mask",
+         "value": compression["mask"]["compression_x"], "unit": "x",
+         "vs_baseline": None},
+        {"metric": "serve_cascade_steady_state_compile_misses",
+         "value": (flagship_only["steady_state_compile_misses"]
+                   + sum(s["steady_state_compile_misses"] for s in sweep)
+                   + rung_misses),
+         "unit": "compiles", "vs_baseline": None},
+    ]
+    return records, report
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -2988,6 +3326,19 @@ def main():
              "padding config, p50/p99 through the replica pool, and "
              "zero steady-state recompiles",
     )
+    ap.add_argument(
+        "--cascade", action="store_true",
+        help="compression ladder + confidence-gated cascade bench "
+             "(ISSUE 18): escalation-threshold sweep tracing cost-per-"
+             "image vs accuracy on a modeled two-family registry "
+             "(matched-accuracy cost reduction + 100%%-escalation "
+             "byte-identity), plus the {box,mask} x {f32,bf16,int8} "
+             "parity matrix on real tiny models",
+    )
+    ap.add_argument("--cascade_requests", type=int, default=80)
+    ap.add_argument("--cascade_hard_pct", type=float, default=30.0,
+                    help="percent of images the cheap family answers "
+                         "wrong (and scores low) in --cascade")
     ap.add_argument(
         "--serve_fault", action="store_true",
         help="fault-matrix serving bench: healthy vs wedged vs flapping "
@@ -3194,6 +3545,18 @@ def main():
             concurrency=args.serve_concurrency // 2 or 8,
             device_ms=args.overlap_device_ms,
             fetch_ms=args.overlap_fetch_ms,
+        )
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
+
+    if args.cascade:
+        records, report = bench_cascade(
+            requests=args.cascade_requests,
+            hard_pct=args.cascade_hard_pct,
         )
         for rec in records:
             print(json.dumps(rec), flush=True)

@@ -372,6 +372,71 @@ def _check_rollout_schema(name: str, doc: dict) -> List[str]:
     return errors
 
 
+_CASCADE_CLAIMS = (
+    "cost_reduction_ge_1p3x_at_matched_accuracy",
+    "full_escalation_byte_identical",
+    "zero_steady_state_recompiles",
+    "int8_parity_ok_box_and_mask",
+    "bf16_parity_ok_box_and_mask",
+)
+
+_CASCADE_METRIC_PREFIXES = (
+    "serve_cascade_cost_ms_per_image",
+    "serve_cascade_cost_reduction",
+    "serve_cascade_accuracy",
+    "serve_cascade_escalation_rate",
+    "serve_cascade_parity_rungs_ok",
+    "serve_cascade_int8_compression",
+    "serve_cascade_steady_state_compile_misses",
+)
+
+
+def _check_cascade_schema(name: str, doc: dict) -> List[str]:
+    errors = []
+    report = doc.get("report") if isinstance(doc, dict) else None
+    if not isinstance(report, dict):
+        return [f"bench artifact {name}: missing report object"]
+    claims = report.get("claims")
+    if not isinstance(claims, dict):
+        return [f"bench artifact {name}: report.claims missing"]
+    for c in _CASCADE_CLAIMS:
+        if c not in claims:
+            errors.append(f"bench artifact {name}: claim '{c}' missing")
+        elif claims[c] is not True:
+            errors.append(f"bench artifact {name}: claim '{c}' not true")
+    sweep = report.get("sweep")
+    if not isinstance(sweep, list) or len(sweep) < 2:
+        errors.append(
+            f"bench artifact {name}: report.sweep missing — the cost "
+            f"claim has no threshold-curve evidence"
+        )
+    matrix = report.get("parity_matrix")
+    if not isinstance(matrix, list) or {
+        (r.get("family"), r.get("precision"))
+        for r in matrix
+        if isinstance(r, dict)
+    } != {
+        (f, p)
+        for f in ("box", "mask")
+        for p in ("f32", "bf16", "int8")
+    }:
+        errors.append(
+            f"bench artifact {name}: report.parity_matrix must cover "
+            f"{{box,mask}} x {{f32,bf16,int8}}"
+        )
+    metrics = {
+        r.get("metric", "")
+        for r in doc.get("records", [])
+        if isinstance(r, dict)
+    }
+    for prefix in _CASCADE_METRIC_PREFIXES:
+        if not any(m.startswith(prefix) for m in metrics):
+            errors.append(
+                f"bench artifact {name}: no record metric '{prefix}*'"
+            )
+    return errors
+
+
 def check_bench_artifacts(root: Path) -> List[str]:
     errors = []
     for f in sorted(root.glob("BENCH_*.json")):
@@ -397,6 +462,8 @@ def check_bench_artifacts(root: Path) -> List[str]:
             errors += _check_scale_schema(f.name, doc)
         if f.name == "BENCH_rollout_cpu.json":
             errors += _check_rollout_schema(f.name, doc)
+        if f.name == "BENCH_cascade_cpu.json":
+            errors += _check_cascade_schema(f.name, doc)
     return errors
 
 

@@ -41,14 +41,23 @@ class Predictor:
     only sigmoid + paste + RLE-encode."""
 
     def __init__(self, model, params, postprocess=None, donate: bool = False,
-                 deterministic: bool = False):
+                 deterministic: bool = False, params_transform=None):
         self.model = model
         self.params = params
 
         # batch keys match the model __call__ kwargs (gt keys are accepted
         # and ignored by test forwards; FastRCNN additionally consumes
         # proposals/prop_valid)
+        #
+        # params_transform (int8 rung, core/quantize.py): a jit-traceable
+        # tree→tree map applied to the params argument INSIDE the jit —
+        # the bound tree can then be a compressed form (int8 q + scale
+        # leaves) that dequantizes on use, with XLA fusing the broadcast
+        # multiply into each weight's consumer.  Params stay a traced
+        # argument, so hot-swap pointer flips still reuse the executable.
         def fwd(p, batch):
+            if params_transform is not None:
+                p = params_transform(p)
             batch = dict(batch)
             orig_hw = batch.pop("orig_hw", None)
             out = model.apply({"params": p}, train=False, **batch)

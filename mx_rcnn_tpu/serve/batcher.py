@@ -111,6 +111,14 @@ class Request:
     solo: bool = False                   # engine resubmit: release as batch-of-1
     tenant: Optional[str] = None         # fair-share identity (None = untagged)
     arm_version: Optional[int] = None    # rollout split arm (None = incumbent)
+    # confidence-gated cascade (ISSUE 18): `cascade` marks a cheap
+    # first-pass request whose completion runs the gate; `escalated`
+    # marks its flagship re-entry (already-admitted, like solo, but
+    # batched normally); `raw_image` is the validated original pixels
+    # kept so escalation can re-prepare for the flagship's config
+    cascade: bool = False
+    escalated: bool = False
+    raw_image: Optional["np.ndarray"] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -178,7 +186,9 @@ class DynamicBatcher:
             # a solo resubmit is an already-admitted in-flight request
             # bouncing through containment; rejecting it here would turn
             # quarantine into request loss, so it re-enters above the cap
-            if self._count >= self.max_queue and not req.solo:
+            # — a cascade escalation is the same in-flight re-entry
+            # (admitted once at submit), just batched normally
+            if self._count >= self.max_queue and not (req.solo or req.escalated):
                 raise QueueFull(
                     f"serving queue at capacity ({self.max_queue}) — "
                     f"client should back off"

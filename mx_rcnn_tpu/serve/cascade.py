@@ -1,0 +1,172 @@
+"""Confidence-gated model cascade: cheap first pass, escalate on doubt.
+
+The per-request cost attack that rides on the multi-tenant registry
+(ISSUE 18 / ROADMAP item 3).  Every request targeting the flagship
+family is first served by a cheap family (e.g. C4-small); a pure-host
+confidence gate over the first pass's decoded detections decides
+whether the cheap answer ships or the request escalates to the
+flagship.  The upstream paper's alternate-training heritage (PAPER.md
+§1) means the families share calibration data, so the cheap family's
+scores are a usable uncertainty signal for the flagship's.
+
+Division of labour
+------------------
+
+This module is the POLICY — a frozen threshold pair, a pure function
+from decoded detections to sufficient/escalate, and the escalation
+counters the cost claim is backed by.  All ROUTING lives in
+``engine.ServingEngine`` (``attach_cascade`` + the submit/complete
+hooks): the first pass enters the batcher as a normal cheap-family
+request, and an escalated request re-enters the normal batcher path as
+a flagship request carrying the ORIGINAL lane/tenant/deadline/digest —
+escalation changes which model serves, never the request's identity or
+its SLO accounting.
+
+Lock discipline (graftlint R4): the gate itself takes no lock — it is
+a pure numpy reduction over host arrays.  The counter lock here is a
+leaf: nothing is called under it, and in particular no ``device_put``
+or jit dispatch ever runs while it is held — escalation re-entry
+(batcher submit, request re-preparation) happens strictly outside it.
+
+Cache correctness: the gate is deterministic in (policy, cheap-family
+version, image bytes), so for one policy a digest maps to exactly one
+final serving — the engine keys ``ResponseCache`` entries by the final
+(family, version, precision, digest), and a cheap-family byte can never
+be stored or found under a flagship key.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+
+__all__ = ["CascadePolicy", "CascadeRouter", "detection_stats", "parse_cascade_spec"]
+
+
+@dataclass(frozen=True)
+class CascadePolicy:
+    """Which families form the cascade and when the first pass suffices.
+
+    The first pass is sufficient when its most confident detection
+    reaches ``min_score`` AND it produced at least ``min_dets``
+    detections; otherwise the request escalates.  ``min_score > 1.0``
+    therefore forces 100% escalation (scores are probabilities) — the
+    byte-identity control arm — and ``min_score <= 0.0`` with
+    ``min_dets == 0`` never escalates.
+    """
+
+    cheap: str
+    flagship: str
+    min_score: float = 0.5
+    min_dets: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.cheap or not self.flagship:
+            raise ValueError("cascade needs both a cheap and a flagship family")
+        if self.cheap == self.flagship:
+            raise ValueError("cascade cheap and flagship must differ")
+        if self.min_dets < 0:
+            raise ValueError("min_dets must be >= 0")
+
+
+def detection_stats(cls_dets: Optional[Sequence[Any]]) -> Tuple[int, float]:
+    """(count, max score) over a decoded per-class detection list.
+
+    Accepts the ``detections_for`` shape used everywhere in this repo:
+    a list indexed by class id (index 0 = background, usually ``None``)
+    of ``(n, 5+)`` arrays whose column 4 is the score.  Entries that are
+    ``None``, empty, or not score-bearing contribute nothing.  An empty
+    pass scores 0.0 — "confidently empty" needs ``min_score <= 0``.
+    """
+    n = 0
+    mx = 0.0
+    for arr in cls_dets or ():
+        if arr is None:
+            continue
+        a = np.asarray(arr)
+        if a.ndim != 2 or a.shape[1] < 5 or a.shape[0] == 0:
+            continue
+        n += int(a.shape[0])
+        mx = max(mx, float(a[:, 4].max()))
+    return n, mx
+
+
+def parse_cascade_spec(spec: str) -> CascadePolicy:
+    """Parse the CLI knob ``CHEAP>FLAGSHIP[:THRESH]``.
+
+    e.g. ``resnet50_small>resnet50`` (default threshold) or
+    ``c4_small>flagship:0.65``.
+    """
+    body, sep, thresh = spec.partition(":")
+    cheap, arrow, flagship = body.partition(">")
+    if not arrow:
+        raise ValueError(
+            f"bad --cascade spec {spec!r}: expected CHEAP>FLAGSHIP[:THRESH]"
+        )
+    kw: Dict[str, Any] = {}
+    if sep:
+        kw["min_score"] = float(thresh)
+    return CascadePolicy(cheap=cheap.strip(), flagship=flagship.strip(), **kw)
+
+
+class CascadeRouter:
+    """The gate + its counters.  One per engine; thread-safe.
+
+    ``sufficient()`` is called from completion workers with decoded
+    host detections — it never touches the device, the batcher, or any
+    engine lock, so it can never deadlock against the dispatch path.
+    """
+
+    def __init__(self, policy: CascadePolicy):
+        self.policy = policy
+        self._lock = make_lock("CascadeRouter._lock")
+        self._first_pass = 0       # cheap passes gated (decisions made)
+        self._sufficient = 0       # served by the cheap family
+        self._escalated = 0        # re-entered the batcher as flagship
+        self._max_score_sum = 0.0  # running mean evidence for the report
+
+    # -- pure host gate ------------------------------------------------
+
+    def sufficient(self, cls_dets: Optional[Sequence[Any]]) -> bool:
+        """True if the cheap pass ships; False → escalate.
+
+        Deterministic in (policy, detections): no randomness, no state,
+        so replaying a digest replays the routing decision — the
+        property the response-cache key scheme relies on.
+        """
+        n, mx = detection_stats(cls_dets)
+        ok = n >= self.policy.min_dets and mx >= self.policy.min_score
+        with self._lock:
+            self._first_pass += 1
+            self._max_score_sum += mx
+            if ok:
+                self._sufficient += 1
+            else:
+                self._escalated += 1
+        return ok
+
+    # -- counters ------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            first = self._first_pass
+            suff = self._sufficient
+            esc = self._escalated
+            score_sum = self._max_score_sum
+        return {
+            "cheap": self.policy.cheap,
+            "flagship": self.policy.flagship,
+            "min_score": self.policy.min_score,
+            "min_dets": self.policy.min_dets,
+            "first_pass": first,
+            "first_pass_sufficient": suff,
+            "escalations": esc,
+            "escalation_rate": round(esc / first, 4) if first else 0.0,
+            "mean_first_pass_max_score": (
+                round(score_sum / first, 4) if first else 0.0
+            ),
+        }

@@ -153,6 +153,11 @@ class ServingEngine:
         # via attach_rollout — submit consults it for arm assignment,
         # _complete feeds it evidence; stop() joins it with the swaps
         self.rollout = None
+        # confidence-gated cascade (ISSUE 18): a CascadeRouter attached
+        # via attach_cascade — submit reroutes flagship requests to the
+        # cheap family, _complete runs the gate and escalates uncertain
+        # first passes back through the batcher as flagship requests
+        self.cascade = None
         # every not-yet-resolved request, so stop() can sweep leftovers
         # with a terminal EngineStopped instead of stranding submitters
         self._live: Dict[int, Request] = {}
@@ -266,6 +271,44 @@ class ServingEngine:
             reg, self.runner, engine=self, policy=policy
         )
         return self.rollout
+
+    def attach_cascade(self, policy) -> "CascadeRouter":
+        """Bind a :class:`~mx_rcnn_tpu.serve.cascade.CascadePolicy` to
+        this engine.  From here every request resolving to the policy's
+        flagship family first serves on the cheap family; ``_complete``
+        runs the pure-host confidence gate on the first pass's
+        detections and either resolves (sufficient) or re-enters the
+        batcher as a flagship request with the original lane, tenant,
+        deadline, digest, and retry budget intact.  Requests addressed
+        to any other family — including direct cheap-family traffic —
+        are untouched."""
+        from mx_rcnn_tpu.serve.cascade import CascadePolicy, CascadeRouter
+
+        if not isinstance(policy, CascadePolicy):
+            policy = CascadePolicy(**dict(policy))
+        reg = getattr(self.runner, "registry", None)
+        if reg is not None:
+            for mid in (policy.cheap, policy.flagship):
+                if not reg.has(mid):
+                    from mx_rcnn_tpu.serve.registry import UnknownModel
+
+                    raise UnknownModel(
+                        f"cascade family {mid!r} is not registered"
+                    )
+        self.cascade = CascadeRouter(policy)
+        return self.cascade
+
+    def _precision_tag(self, model: Optional[str]) -> str:
+        """Serve-graph precision of ``model`` on this engine's runner
+        ("f32" for stub runners without precision plumbing) — joins the
+        response-cache key so rungs never share bytes."""
+        pf = getattr(self.runner, "_precision_for", None)
+        if pf is None:
+            return "f32"
+        try:
+            return pf(self._resolved_mid(model))
+        except Exception:  # noqa: BLE001 — unknown model: default tag
+            return "f32"
 
     def _resolved_mid(self, model: Optional[str]) -> Optional[str]:
         """Registry model id a request resolves to (the rollout tables
@@ -392,51 +435,74 @@ class ServingEngine:
                     f"digest {digest[:12]} is quarantined (query of death)"
                 )
         lane = self._lane_for(model, lane)
+        # cascade reroute (ISSUE 18): a request resolving to the
+        # flagship family serves the cheap family first; the gate at
+        # completion decides escalation.  The LANE above was resolved
+        # from the original (flagship) target — the cheap pass and any
+        # escalation both ride it, so cascading never demotes an SLO.
+        serve_model = model
+        cascade_first = False
+        if self.cascade is not None \
+                and self._resolved_mid(model) == self.cascade.policy.flagship:
+            serve_model = self.cascade.policy.cheap
+            cascade_first = True
         arm_version = None
         if self.rollout is not None:
             # deterministic arm assignment (ISSUE 17): the content
             # digest — not a coin flip — picks the arm, so a repeated
             # request always lands on the same version and the response
-            # cache stays arm-coherent by construction
-            mid_r = self._resolved_mid(model)
+            # cache stays arm-coherent by construction.  Under a
+            # cascade the first pass serves the CHEAP family, so its
+            # rollouts are the ones consulted here; a flagship rollout
+            # is consulted at escalation time instead.
+            mid_r = self._resolved_mid(serve_model)
             if mid_r is not None and self.rollout.active(mid_r):
                 if digest is None:
                     digest = request_digest(im)
                 arm_version = self.rollout.arm_for(mid_r, digest)
         cache_key = None
         if self.response_cache is not None:
+            t0 = time.monotonic()
+            if cascade_first:
+                # the final serving of a cascaded digest may be the
+                # flagship (escalated earlier) — probe that key first;
+                # the gate is deterministic per (policy, cheap version,
+                # image), so at most one of the two keys can exist
+                fmid = self.cascade.policy.flagship
+                fver = self._live_version(fmid)
+                if fver is not None:
+                    fhit = self.response_cache.get(
+                        self.response_cache.key_for(
+                            im, fmid, fver, self._precision_tag(fmid)
+                        )
+                    )
+                    if fhit is not None:
+                        return self._cached_future(
+                            fhit, t0, lane, tenant, model
+                        )
             # split serving: the key carries the SERVED arm's version,
             # not the live pointer — two versions serve concurrently
             # under a split and must never share cache entries
             version = (
                 arm_version if arm_version is not None
-                else self._live_version(model)
+                else self._live_version(serve_model)
             )
             if version is not None:
-                t0 = time.monotonic()
                 reg = getattr(self.runner, "registry", None)
                 mid = (
-                    model if model is not None
+                    serve_model if serve_model is not None
                     else getattr(self.runner, "default_model", None)
                     or reg.default_model
                 )
-                cache_key = self.response_cache.key_for(im, mid, version)
+                cache_key = self.response_cache.key_for(
+                    im, mid, version, self._precision_tag(mid)
+                )
                 hit = self.response_cache.get(cache_key)
                 if hit is not None:
                     # byte-identical by construction: the stored arrays
                     # ARE what the miss returned (callers treat
                     # detections as immutable)
-                    f: Future = Future()
-                    f.set_result(hit)
-                    self.metrics.inc("submitted")
-                    self.metrics.inc("completed")
-                    e2e = time.monotonic() - t0
-                    self.metrics.e2e.record(e2e)
-                    self.metrics.record_lane(lane, e2e_s=e2e)
-                    self.metrics.record_tenant(tenant, e2e_s=e2e)
-                    if model is not None:
-                        self.metrics.record_model(model, e2e)
-                    return f
+                    return self._cached_future(hit, t0, lane, tenant, model)
         cap = self.batcher.max_queue
         if self._routed:
             # load shedding: scale the effective intake capacity by the
@@ -482,15 +548,20 @@ class ServingEngine:
         try:
             # model passed only when explicit, so runner fakes/stubs with
             # the legacy two-arg make_request keep working unchanged
-            if model is None:
+            if serve_model is None:
                 req = self.runner.make_request(im, deadline=deadline)
             else:
                 req = self.runner.make_request(
-                    im, deadline=deadline, model=model
+                    im, deadline=deadline, model=serve_model
                 )
             req.lane = lane
             req.tenant = tenant
             req.cache_key = cache_key
+            if cascade_first:
+                # keep the validated pixels so an escalation can
+                # re-prepare them for the flagship family's config
+                req.cascade = True
+                req.raw_image = im
             if digest is not None:
                 req.digest = digest
                 if self._quarantine is not None:
@@ -514,6 +585,28 @@ class ServingEngine:
         self.metrics.inc("submitted")
         self.metrics.record_queue_depth(self.batcher.pending())
         return req.future
+
+    def _cached_future(
+        self,
+        hit,
+        t0: float,
+        lane: str,
+        tenant: Optional[str],
+        model: Optional[str],
+    ) -> Future:
+        """Resolve a response-cache hit: a pre-completed Future plus the
+        same request accounting a recompute would have produced."""
+        f: Future = Future()
+        f.set_result(hit)
+        self.metrics.inc("submitted")
+        self.metrics.inc("completed")
+        e2e = time.monotonic() - t0
+        self.metrics.e2e.record(e2e)
+        self.metrics.record_lane(lane, e2e_s=e2e)
+        self.metrics.record_tenant(tenant, e2e_s=e2e)
+        if model is not None:
+            self.metrics.record_model(model, e2e)
+        return f
 
     # ------------------------------------------------------------- device
     def _expire_swept(self, req: Request, now: float) -> None:
@@ -652,6 +745,19 @@ class ServingEngine:
                 self.metrics.record_tenant(r.tenant, ok=False)
                 self._resolve(r, exc=e)
                 continue
+            if r.cascade and not r.escalated and self.cascade is not None:
+                # confidence gate (ISSUE 18): pure host numpy over the
+                # decoded cheap-pass detections — no lock held, nothing
+                # on device.  Sufficient → the cheap answer ships below
+                # under the CHEAP family's cache key; uncertain → the
+                # request re-enters the batcher as a flagship request
+                # and nothing about this pass is cached or resolved.
+                if self.cascade.sufficient(dets):
+                    self.metrics.inc("first_pass_sufficient")
+                else:
+                    self.metrics.inc("escalations")
+                    self._escalate(r)
+                    continue
             if r.cache_key is not None and self.response_cache is not None:
                 # store only if the version that SERVED is still the one
                 # the key was minted against — a swap that landed
@@ -795,6 +901,70 @@ class ServingEngine:
         except Exception as e:  # noqa: BLE001 — closed batcher at stop
             self._fail_one(req, e)
 
+    def _escalate(self, req: Request) -> None:
+        """Re-enter an uncertain cascade first pass as a flagship
+        request.  The new request carries the ORIGINAL future, lane,
+        tenant, absolute deadline, enqueue time, digest, and retry
+        budget — escalation changes which model serves, never the
+        request's identity — and is marked ``escalated`` so it re-enters
+        above the queue cap (it was admitted once, at submit) and the
+        gate never runs twice.  Exactly-once: the original request's
+        live-set entry is REPLACED by the escalated one in the same
+        locked section, so a concurrent ``stop`` sweep resolves the
+        shared future exactly once, from whichever entry it finds."""
+        pol = self.cascade.policy
+        if req.expired():
+            self.metrics.inc("expired")
+            self.metrics.record_lane(req.lane, expired=True)
+            self.metrics.record_tenant(req.tenant, expired=True)
+            self._resolve(req, exc=DeadlineExceeded(
+                "deadline passed before escalation could re-enter"
+            ))
+            return
+        try:
+            req2 = self.runner.make_request(
+                req.raw_image, deadline=req.deadline, model=pol.flagship
+            )
+        except Exception as e:  # noqa: BLE001 — flagship prep failed
+            self._fail_one(req, e)
+            return
+        req2.future = req.future
+        req2.lane = req.lane
+        req2.tenant = req.tenant
+        req2.enqueue_t = req.enqueue_t  # e2e spans both passes
+        req2.digest = req.digest
+        req2.budget = req.budget
+        req2.escalated = True
+        if self.rollout is not None and self.rollout.active(pol.flagship):
+            # a flagship rollout splits escalated traffic too — same
+            # digest-deterministic assignment as submit, so a repeated
+            # escalation lands on the same arm.  Submit only digests
+            # when quarantine or a CHEAP-family rollout is on, so the
+            # digest may still be missing here
+            if req2.digest is None:
+                req2.digest = request_digest(req.raw_image)
+            arm_version = self.rollout.arm_for(pol.flagship, req2.digest)
+            if arm_version is not None:
+                req2.arm_version = arm_version
+                req2.solo = True
+        if self.response_cache is not None:
+            version = (
+                req2.arm_version if req2.arm_version is not None
+                else self._live_version(pol.flagship)
+            )
+            if version is not None:
+                req2.cache_key = self.response_cache.key_for(
+                    req.raw_image, pol.flagship, version,
+                    self._precision_tag(pol.flagship),
+                )
+        with self._live_lock:
+            self._live.pop(id(req), None)
+            self._live[id(req2)] = req2
+        try:
+            self.batcher.submit(req2)
+        except Exception as e:  # noqa: BLE001 — closed batcher at stop
+            self._fail_one(req2, e)
+
     # ----------------------------------------------------------- lifecycle
     def swap(
         self,
@@ -864,6 +1034,15 @@ class ServingEngine:
             out["autoscaler"] = self.autoscaler.snapshot()
         if self.rollout is not None:
             out["rollout"] = self.rollout.snapshot()
+        if self.cascade is not None:
+            out["cascade"] = self.cascade.snapshot()
+        dmm = getattr(self.runner, "device_ms_by_model", None)
+        if dmm:
+            # single-runner engines surface the cost counter directly;
+            # routed pools already merge it into out["pool"]["overlap"]
+            out["device_ms_by_model"] = {
+                k: round(v, 3) for k, v in dmm.items()
+            }
         reg = getattr(self.runner, "registry", None)
         if reg is not None:
             out["registry"] = reg.snapshot()

@@ -142,6 +142,10 @@ class OverlapStats:
         self.idle_fetch_s = 0.0   # fetch time with an otherwise-empty window
         self.fetch_bytes = 0
         self.fetch_bytes_by_model: Dict[str, int] = {}
+        # per-request cost accounting (ISSUE 18): dispatch→complete wall
+        # per batch attributed to the serving model — pool-merged like
+        # fetch_bytes, the counter behind the cascade's cost claim
+        self.device_ms_by_model: Dict[str, float] = {}
         self._t0: Optional[float] = None   # first dispatch ever
         self._t_last: Optional[float] = None
 
@@ -161,6 +165,7 @@ class OverlapStats:
         hidden: bool,
         nbytes: int = 0,
         model: Optional[str] = None,
+        device_ms: float = 0.0,
     ) -> None:
         s = max(float(seconds), 0.0)
         with self._lock:
@@ -170,11 +175,15 @@ class OverlapStats:
                 self.hidden_host_s += s
             else:
                 self.idle_fetch_s += s
+            key = model if model is not None else "default"
             if nbytes:
                 self.fetch_bytes += int(nbytes)
-                key = model if model is not None else "default"
                 self.fetch_bytes_by_model[key] = (
                     self.fetch_bytes_by_model.get(key, 0) + int(nbytes)
+                )
+            if device_ms:
+                self.device_ms_by_model[key] = (
+                    self.device_ms_by_model.get(key, 0.0) + float(device_ms)
                 )
 
     def note_hidden(self, seconds: float) -> None:
@@ -200,6 +209,10 @@ class OverlapStats:
                 "device_busy_fraction": busy,
                 "fetch_bytes": self.fetch_bytes,
                 "fetch_bytes_by_model": dict(self.fetch_bytes_by_model),
+                "device_ms_by_model": {
+                    k: round(v, 3)
+                    for k, v in self.device_ms_by_model.items()
+                },
             }
 
 
@@ -226,6 +239,10 @@ class ServeMetrics:
         # tenant-fair front door (ISSUE 16)
         self.over_budget = 0   # token-bucket rejections (TenantOverBudget)
         self.tenant_shed = 0   # over-share tenant shed under pressure
+        # confidence-gated cascade (ISSUE 18): decisions of the
+        # first-pass gate — together they count every gated cheap pass
+        self.escalations = 0           # cheap pass uncertain → flagship
+        self.first_pass_sufficient = 0  # cheap pass served the request
         # query-of-death containment stages (ISSUE 12)
         self.invalid = 0       # rejected at the admission gate
         self.poisoned = 0      # failed fast on a quarantined digest
@@ -408,6 +425,8 @@ class ServeMetrics:
                     "exhausted": self.exhausted,
                     "resubmitted": self.resubmitted,
                     "exonerated": self.exonerated,
+                    "escalations": self.escalations,
+                    "first_pass_sufficient": self.first_pass_sufficient,
                 },
                 "batches": {
                     "count": self.batches,

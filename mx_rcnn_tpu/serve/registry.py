@@ -202,6 +202,14 @@ class ModelRegistry:
         # called OUTSIDE the registry lock — listeners take their own
         # leaf locks and must never re-enter the registry
         self._live_listeners: List[Any] = []
+        # int8 rung (core/quantize.py): per-(model, version) quantized
+        # trees, computed once at registry load/restore and shared by
+        # every runner/replica serving that version — scales are folded
+        # HERE, never per slot and never on the predict path.  Families
+        # in _quantize_on_load get their candidate versions quantized on
+        # the swap restore path, before the commit flip.
+        self._quantized: Dict[Any, Any] = {}
+        self._quantize_on_load: set = set()
 
     # ----------------------------------------------------------- versions
     def _transition(
@@ -238,6 +246,7 @@ class ModelRegistry:
             if ver.params is not None:
                 ver.params = None
                 self.versions_released += 1
+            self._quantized.pop((ver.model_id, ver.version), None)
 
     # ------------------------------------------------------------- models
     def register(
@@ -326,6 +335,60 @@ class ModelRegistry:
         ``serve.quarantine`` defaults apply."""
         with self._lock:
             return dict(self.entry(model_id).limits)
+
+    # ------------------------------------------------ int8 weight rung
+    def enable_quantization(self, model_id: Optional[str] = None) -> None:
+        """Mark a family for the int8 rung: its live version is
+        quantized now (registry-load fold) and every future swap
+        candidate is quantized on the restore path, so the commit flip
+        and the runners' ``_sync`` never pay the fold."""
+        with self._lock:
+            mid = self.default_model if model_id is None else model_id
+            if mid not in self._entries:
+                raise UnknownModel(mid)
+            self._quantize_on_load.add(mid)
+        self.quantized_tree(mid)
+
+    def quantized_tree(
+        self, model_id: Optional[str] = None, version: Optional[int] = None
+    ) -> Any:
+        """The per-channel int8 quantized form of a version's params
+        (live version when ``version`` is None), computed once and
+        cached per ``(model, version)``; dropped at retire alongside the
+        f32 tree.  The quantized tree's structure is a pure function of
+        the f32 structure, so the swap-time f32 structure gate remains
+        the single compile-signature authority."""
+        from mx_rcnn_tpu.core.quantize import quantize_tree
+
+        with self._lock:
+            ver = (
+                self.live(model_id)
+                if version is None
+                else self._version(model_id, version)
+            )
+            key = (ver.model_id, ver.version)
+            cached = self._quantized.get(key)
+            if cached is not None:
+                return cached
+            params = ver.params
+            if params is None:
+                raise RegistryError(
+                    f"model {ver.model_id!r} v{ver.version} params released — "
+                    f"cannot quantize a retired version"
+                )
+        # fold outside the lock: pure host numpy over a tree we hold a
+        # reference to; racing computations produce identical content
+        qtree = quantize_tree(params)
+        with self._lock:
+            return self._quantized.setdefault(key, qtree)
+
+    def _version(self, model_id: Optional[str], version: int) -> ModelVersion:
+        with self._lock:
+            e = self.entry(model_id)
+            for v in e.versions:
+                if v.version == int(version):
+                    return v
+            raise UnknownVersion(f"{e.model_id} v{version}")
 
     # --------------------------------------------- live-change listeners
     def subscribe_live(self, callback: Any) -> None:
@@ -540,6 +603,12 @@ class SwapController:
                 )
             ver.params = params
             ver.digest = man.get("checksum")
+            # int8 rung: fold the candidate's per-channel scales on the
+            # restore path (off the serve path) so runners adopting the
+            # new version after the commit flip find the quantized tree
+            # already cached
+            if e.model_id in reg._quantize_on_load:
+                reg.quantized_tree(e.model_id, ver.version)
             self._abort_check()
 
             # WARMING: candidate params through every served signature,

@@ -509,9 +509,10 @@ class Replica:
             self.overlap.note_fetch(
                 time.monotonic() - t_f, hidden=hidden,
                 # complete() just ran on THIS thread, so the runner's
-                # last-fetch size is this dispatch's host copy
+                # last-fetch size/cost are this dispatch's host copy
                 nbytes=getattr(self.runner, "last_fetch_bytes", 0),
                 model=getattr(d.handle, "model", None),
+                device_ms=getattr(self.runner, "last_device_ms", 0.0),
             )
         except Exception as first:  # noqa: BLE001 — in-place retry tail
             try:

@@ -1,13 +1,18 @@
 """Idempotent response cache for duplicate-heavy serving traffic.
 
-Detection is a pure function of ``(model params version, input image)``
-— the serve stack compiles deterministically per signature and a swap
-changes results only through the live version pointer.  That makes the
-response cacheable by content: the key is ``(model_id, live_version,
-blake2b(image bytes + shape + dtype))``, so a hit can only ever return
-what the identical request would have recomputed, byte for byte (the
-stored detections arrays are returned as-is; callers treat detections as
-immutable, which every existing consumer already does).
+Detection is a pure function of ``(model params version, serve-graph
+precision, input image)`` — the serve stack compiles deterministically
+per signature and a swap changes results only through the live version
+pointer.  That makes the response cacheable by content: the key is
+``(model_id, live_version, precision, blake2b(image bytes + shape +
+dtype))``, so a hit can only ever return what the identical request
+would have recomputed, byte for byte (the stored detections arrays are
+returned as-is; callers treat detections as immutable, which every
+existing consumer already does).  Precision joined the key with the
+compression ladder (ISSUE 18): an f32 and an int8 serving of the same
+family must never share bytes, and under a cascade the key always names
+the family that actually served — cheap-family bytes can never be
+stored under a flagship key.
 
 Version is part of the key, so a hot-swap can never serve stale bytes —
 but stale entries would still occupy capacity, so the registry notifies
@@ -58,8 +63,17 @@ class ResponseCache:
         h.update(arr.tobytes())
         return h.hexdigest()
 
-    def key_for(self, im: np.ndarray, model_id: str, version: int) -> Tuple:
-        return (model_id, int(version), self.digest(im))
+    def key_for(
+        self,
+        im: np.ndarray,
+        model_id: str,
+        version: int,
+        precision: str = "f32",
+    ) -> Tuple:
+        """Key layout ``(model, version, precision, digest)`` — index 1
+        stays the version (the engine's put-guard reads it) and index 0
+        the family (:meth:`invalidate_model` matches on it)."""
+        return (model_id, int(version), str(precision), self.digest(im))
 
     # -------------------------------------------------------------- lookup
     def get(self, key: Tuple):

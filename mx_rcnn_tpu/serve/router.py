@@ -73,6 +73,16 @@ def _merge_byte_counts(dicts) -> Dict[str, int]:
     return merged
 
 
+def _merge_ms_counts(dicts) -> Dict[str, float]:
+    """Sum per-model millisecond counters across replica snapshots
+    (device-ms cost accounting, ISSUE 18)."""
+    merged: Dict[str, float] = {}
+    for d in dicts:
+        for k, v in d.items():
+            merged[k] = round(merged.get(k, 0.0) + float(v), 3)
+    return merged
+
+
 class NoHealthyReplica(RuntimeError):
     """Every replica is draining/recovering — the pool has zero capacity
     (the engine surfaces this as a failed batch; intake shedding should
@@ -622,6 +632,9 @@ class ReplicaPool:
                 "fetch_bytes": sum(o.get("fetch_bytes", 0) for o in overlap),
                 "fetch_bytes_by_model": _merge_byte_counts(
                     o.get("fetch_bytes_by_model", {}) for o in overlap
+                ),
+                "device_ms_by_model": _merge_ms_counts(
+                    o.get("device_ms_by_model", {}) for o in overlap
                 ),
             },
             "compile": self.compile_cache.snapshot(),

@@ -47,17 +47,19 @@ from mx_rcnn_tpu.serve.buckets import BucketLadder, CompileCache
 ClsDets = List[Optional[np.ndarray]]  # [None, (n1, 5), ..., (nK-1, 5)]
 
 #: compile-cache precision tags — part of every jit signature, so the
-#: f32 and bf16 serve graphs can never collide on one cache key
+#: f32, bf16, and int8 serve graphs can never collide on one cache key
 _PRECISION_TAGS = {
     None: "f32", "float32": "f32", "f32": "f32",
     "bfloat16": "bf16", "bf16": "bf16",
+    "int8": "int8",
 }
 
 
 class PrecisionParityError(RuntimeError):
-    """The bf16 serve graph's detections drifted outside the documented
-    tolerance vs the f32 reference — the precision mode refuses to
-    serve (fail at warmup, not in production results)."""
+    """A reduced-precision serve graph's detections (bf16 compute or
+    int8 weight rung) drifted outside the documented tolerance vs the
+    f32 reference — the precision mode refuses to serve (fail at
+    warmup, not in production results)."""
 
 
 def _box_iou(a: np.ndarray, b: np.ndarray) -> np.ndarray:
@@ -440,7 +442,10 @@ class ServeRunner:
         self._parity_score_tol = float(parity_score_tol)
         self._parity_margin = float(parity_margin)
         self._parity_mask_tol = float(parity_mask_tol)
-        self.parity: Dict[str, Dict] = {}  # model → last gate report
+        # "model:precision" → last gate report.  Precision is part of
+        # the key so one family's int8 report can never overwrite its
+        # bf16 one when snapshots from differently-rung runners merge.
+        self.parity: Dict[str, Dict] = {}
         # registry-resolution state
         self._slots: Dict[str, _ModelSlot] = {}
         self._slots_lock = make_lock("ServeRunner._slots_lock")
@@ -460,6 +465,14 @@ class ServeRunner:
         self.fetch_bytes_total = 0
         self.fetch_bytes_by_model: Dict[str, int] = {}
         self.last_fetch_bytes = 0
+        # per-request cost accounting (ISSUE 18): dispatch→complete wall
+        # per batch, attributed to the serving model — the counter the
+        # cascade's cost-per-image claim is backed by.  On real
+        # accelerators this is device compute + fetch; bench stub
+        # runners book their calibrated device model here instead.
+        self.device_ms_total = 0.0
+        self.device_ms_by_model: Dict[str, float] = {}
+        self.last_device_ms = 0.0
         # build the default slot eagerly: construction fails fast on a
         # bad config, and legacy callers read .predictor immediately
         self._slot(self.default_model)
@@ -473,7 +486,8 @@ class ServeRunner:
         return jax.device_put(tree, self.device)
 
     def _precision_for(self, model_id: str) -> str:
-        """Compile-cache precision tag for ``model_id`` ("f32"/"bf16")."""
+        """Compile-cache precision tag for ``model_id``
+        ("f32"/"bf16"/"int8")."""
         p = self._precision
         if isinstance(p, dict):
             p = p.get(model_id)
@@ -481,6 +495,10 @@ class ServeRunner:
         if tag is None:
             raise ValueError(f"unknown serve precision {p!r}")
         return tag
+
+    def _parity_key(self, model_id: str, precision: str) -> str:
+        """Key of :attr:`parity` reports: ``"model:precision"``."""
+        return f"{model_id}:{precision}"
 
     def _slot(self, model_id: str) -> _ModelSlot:
         s = self._slots.get(model_id)
@@ -525,13 +543,14 @@ class ServeRunner:
                 if self._device_postprocess is None
                 else self._device_postprocess
             )
-            if precision == "bf16" and cfg.network.USE_MASK \
+            if precision in ("bf16", "int8") and cfg.network.USE_MASK \
                     and not self._parity_check:
-                # a bf16 mask graph without the warmup parity gate would
-                # serve unverified mask grids — the gate is what checks
-                # them (check_parity compares grids of matched pairs)
+                # a reduced-precision mask graph without the warmup
+                # parity gate would serve unverified mask grids — the
+                # gate is what checks them (check_parity compares grids
+                # of matched pairs)
                 raise ValueError(
-                    f"precision='bfloat16' for mask model {model_id!r} "
+                    f"precision={precision!r} for mask model {model_id!r} "
                     f"requires parity_check=True (the warmup gate is "
                     f"what verifies the mask grids against f32)"
                 )
@@ -545,10 +564,26 @@ class ServeRunner:
             # deterministic: shape-independent reduction order on CPU,
             # making cross-bucket detections bitwise identical (Predictor
             # docstring); fast mode agrees to ~1e-5 px on box coordinates
-            predictor = Predictor(
-                serve_model, self._place(live.params), postprocess=post,
-                donate=self._donate, deterministic=self._deterministic,
-            )
+            if precision == "int8":
+                # int8 weight rung: the bound tree is the registry's
+                # per-channel quantized form (scales folded once at
+                # registry load, shared across runners/replicas), and
+                # the serve graph dequantizes on use — params stay a
+                # traced jit argument, so swaps remain pointer flips
+                from mx_rcnn_tpu.core.quantize import dequantize_tree
+
+                self.registry.enable_quantization(model_id)
+                qtree = self.registry.quantized_tree(model_id, live.version)
+                predictor = Predictor(
+                    serve_model, self._place(qtree), postprocess=post,
+                    donate=self._donate, deterministic=self._deterministic,
+                    params_transform=dequantize_tree,
+                )
+            else:
+                predictor = Predictor(
+                    serve_model, self._place(live.params), postprocess=post,
+                    donate=self._donate, deterministic=self._deterministic,
+                )
             s = _ModelSlot(
                 model_id, predictor, live.version, cfg, n_cls,
                 bool(cfg.TEST.UINT8_TRANSFER), precision=precision,
@@ -573,9 +608,17 @@ class ServeRunner:
             # lost (rolled back / cancelled): drop its buffers now
             for k in [k for k in self._staged if k[0] == slot.model_id]:
                 self._staged.pop(k, None)
-            slot.predictor.params = (
-                staged if staged is not None else self._place(live.params)
-            )
+            # int8 slots adopt the registry's cached quantized form of
+            # the new version (folded on the swap restore path); staged
+            # trees for such slots were quantized at warm_version time
+            if staged is not None:
+                slot.predictor.params = staged
+            elif slot.precision == "int8":
+                slot.predictor.params = self._place(
+                    self.registry.quantized_tree(slot.model_id, live.version)
+                )
+            else:
+                slot.predictor.params = self._place(live.params)
             slot.version = live.version
             self.swaps_applied += 1
 
@@ -709,6 +752,14 @@ class ServeRunner:
         self.fetch_bytes_by_model[handle.model] = (
             self.fetch_bytes_by_model.get(handle.model, 0) + nbytes
         )
+        # cost accounting: dispatch→complete wall, attributed to the
+        # serving model (cascade cost-per-image evidence, ISSUE 18)
+        dt_ms = (time.monotonic() - handle.dispatch_t) * 1000.0
+        self.last_device_ms = dt_ms
+        self.device_ms_total += dt_ms
+        self.device_ms_by_model[handle.model] = (
+            self.device_ms_by_model.get(handle.model, 0.0) + dt_ms
+        )
         return out
 
     def run(
@@ -776,9 +827,9 @@ class ServeRunner:
                     if layouts is not None:
                         self._layouts[self._signature(batch, mid)] = layouts
             if (
-                slot.precision == "bf16"
+                slot.precision in ("bf16", "int8")
                 and self._parity_check
-                and mid not in self.parity
+                and self._parity_key(mid, slot.precision) not in self.parity
             ):
                 self.check_parity(mid)
         return self.compile_cache.misses
@@ -807,35 +858,37 @@ class ServeRunner:
         model: Optional[str] = None,
         bucket: Optional[Tuple[int, int]] = None,
     ) -> Dict:
-        """Gate a bf16 serve graph on detection parity vs the f32 path.
+        """Gate a reduced-precision serve graph (bf16 compute or int8
+        weight rung) on detection parity vs the f32 path.
 
         Runs one deterministic probe batch (smallest ladder rung unless
-        ``bucket`` overrides) through the model's bf16 slot AND a
-        transient f32 reference predictor built from the registered
-        module + live params, then compares detections with
+        ``bucket`` overrides) through the model's reduced-precision slot
+        AND a transient f32 reference predictor built from the
+        registered module + live params, then compares detections with
         :func:`detection_parity`.  Outside the documented tolerance →
-        :class:`PrecisionParityError`, so a drifting precision config
-        fails at warmup, never in production results.  The f32 reference
-        is a one-shot compile OFF the serving path — it is deliberately
-        not recorded in the compile cache, whose signatures account the
-        programs that serve traffic.  The report lands in
-        ``self.parity[model]`` and engine/bench snapshots."""
+        :class:`PrecisionParityError`, so a drifting precision config —
+        including a corrupted int8 scale fold — fails at warmup, never
+        in production results.  The f32 reference is a one-shot compile
+        OFF the serving path — it is deliberately not recorded in the
+        compile cache, whose signatures account the programs that serve
+        traffic.  The report lands in ``self.parity["model:precision"]``
+        and engine/bench snapshots."""
         mid = self.default_model if model is None else model
         slot = self._slot(mid)
-        if slot.precision != "bf16":
+        if slot.precision not in ("bf16", "int8"):
             report = {"precision": slot.precision, "checked": False}
-            self.parity[mid] = report
+            self.parity[self._parity_key(mid, slot.precision)] = report
             return report
         bucket = tuple(bucket) if bucket else next(iter(self.ladder))
         batch = self._parity_batch(mid, bucket)
         e = self.registry.entry(mid)
         live = self.registry.live(mid)
         self._sync(slot)
-        out_bf16 = slot.predictor.predict(batch)
+        out_rp = slot.predictor.predict(batch)
         # mirror the slot's postprocess flavor (visible in its output
         # keys) so parity measures PRECISION, not device-vs-host NMS
         post = None
-        if "det_boxes" in out_bf16:
+        if "det_boxes" in out_rp:
             from mx_rcnn_tpu.ops.postprocess import make_test_postprocess
 
             post = make_test_postprocess(
@@ -848,8 +901,8 @@ class ServeRunner:
         )
         out_f32 = ref_predictor.predict(batch)
         thresh = float(slot.cfg.TEST.SCORE_THRESH)
-        dets_bf16, masks_bf16 = self.detections_for(
-            out_bf16, batch, 0, model=model, with_masks=True
+        dets_rp, masks_rp = self.detections_for(
+            out_rp, batch, 0, model=model, with_masks=True
         )
         ref_dets, ref_masks = detections_from_output(
             out_f32, batch["im_info"][0], tuple(batch["orig_hw"][0]),
@@ -859,10 +912,10 @@ class ServeRunner:
             ref_dets, e.cfg.TEST.MAX_PER_IMAGE, ref_masks
         )
         report = detection_parity(
-            ref_dets, dets_bf16, thresh, margin=self._parity_margin
+            ref_dets, dets_rp, thresh, margin=self._parity_margin
         )
         report.update(
-            precision="bf16", checked=True, bucket=list(bucket),
+            precision=slot.precision, checked=True, bucket=list(bucket),
             box_tol_px=self._parity_box_tol,
             score_tol=self._parity_score_tol,
         )
@@ -871,7 +924,7 @@ class ServeRunner:
             # mask families must not pass the gate on boxes alone —
             # compare the matched pairs' S×S probability grids too
             report.update(mask_parity(
-                ref_dets, ref_masks or {}, dets_bf16, masks_bf16 or {},
+                ref_dets, ref_masks or {}, dets_rp, masks_rp or {},
                 thresh, margin=self._parity_margin,
             ))
             report["mask_tol"] = self._parity_mask_tol
@@ -883,11 +936,11 @@ class ServeRunner:
             and mask_ok
         )
         report["ok"] = ok
-        self.parity[mid] = report
+        self.parity[self._parity_key(mid, slot.precision)] = report
         if not ok:
             raise PrecisionParityError(
-                f"bf16 serve graph for model {mid!r} outside parity "
-                f"tolerance vs f32: {report}"
+                f"{slot.precision} serve graph for model {mid!r} outside "
+                f"parity tolerance vs f32: {report}"
             )
         return report
 
@@ -913,7 +966,21 @@ class ServeRunner:
         slot = self._slot(mid)
         if abort is not None:
             abort()
-        placed = self._place(params)
+        if slot.precision == "int8":
+            # stage the candidate in the slot's own form: quantized via
+            # the registry's per-version cache (folded once on the
+            # restore path) so N replicas warming the same candidate
+            # share one fold; local fallback covers registries that
+            # stage versions outside the swap path
+            try:
+                tree = self.registry.quantized_tree(mid, int(version))
+            except Exception:  # noqa: BLE001 — e.g. version not in registry
+                from mx_rcnn_tpu.core.quantize import quantize_tree
+
+                tree = quantize_tree(params)
+            placed = self._place(tree)
+        else:
+            placed = self._place(params)
         if buckets is None:
             buckets = sorted(self.served_buckets.get(mid, ())) or list(
                 self.ladder

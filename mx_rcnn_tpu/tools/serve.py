@@ -166,12 +166,14 @@ def main():
                    help="seconds a bulk batch may wait before it takes "
                    "the next slot unconditionally (anti-starvation)")
     p.add_argument("--precision", default="float32",
-                   choices=["float32", "bfloat16"],
+                   choices=["float32", "bfloat16", "int8"],
                    help="serve-graph compute dtype; bfloat16 also folds "
                    "BN and is parity-gated against f32 at warmup (mask "
                    "families: the gate compares S×S mask grids too, and "
                    "the runner refuses bf16 mask models with the gate "
-                   "disabled)")
+                   "disabled).  int8 serves per-channel weight-quantized "
+                   "params (dequantize-on-use), gated by the same warmup "
+                   "parity check")
     p.add_argument("--response_cache", type=int, default=0, metavar="N",
                    help="idempotent response cache capacity (entries); "
                    "0 disables.  Keyed by image digest per (model, "
@@ -182,6 +184,14 @@ def main():
                    "is a committed checkpoint dir or random[:seed].  Load "
                    "is then mixed across the default and every named "
                    "family through the one shared batcher")
+    p.add_argument("--cascade", default=None,
+                   metavar="CHEAP>FLAGSHIP[:THRESH]",
+                   help="confidence-gated cascade: requests addressed to "
+                   "FLAGSHIP first serve on the (registered) CHEAP "
+                   "family; a pure-host gate escalates low-confidence "
+                   "first passes back through the batcher to FLAGSHIP. "
+                   "THRESH is the min top-score to ship the cheap answer "
+                   "(default 0.5)")
     p.add_argument("--swap", default=None, metavar="MODEL=CKPT_DIR",
                    help="hot-swap MODEL to the checkpoint mid-load (the "
                    "'swap <model> <ckpt>' admin command, exercised live)")
@@ -291,6 +301,17 @@ def main():
         response_cache=response_cache,
         tenants=tenants,
     )
+    cascade_router = None
+    if args.cascade:
+        from mx_rcnn_tpu.serve.cascade import parse_cascade_spec
+
+        try:
+            policy = parse_cascade_spec(args.cascade)
+        except ValueError as e:
+            p.error(str(e))
+        cascade_router = engine.attach_cascade(policy)
+        logger.info("cascade: %s -> %s (min_score %.2f)",
+                    policy.cheap, policy.flagship, policy.min_score)
     logger.info(
         "warming up %d bucket(s) x %d model(s) x %d replica(s)...",
         len(runner.ladder), len(registry.model_ids()), args.replicas,
@@ -364,6 +385,8 @@ def main():
         if swapper is not None:
             swapper.join()
             report["swap"] = swap_result
+        if cascade_router is not None:
+            report["cascade"] = cascade_router.snapshot()
     if hasattr(runner, "close"):
         runner.close()
     print(json.dumps(report, indent=1))

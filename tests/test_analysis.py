@@ -1333,3 +1333,176 @@ def test_rollout_artifact_schema_guard(tmp_path):
     assert "'closed_loop_promoted' missing" in errs
     assert "divergence incomplete" in errs
     assert "no record metric 'rollout_split_served*'" in errs
+
+
+# R4 against the ISSUE 18 cascade shape: the router lock is a LEAF
+# guarding only the gate counters — the confidence gate itself runs on
+# host arrays and escalation re-entry goes back through the engine
+# OUTSIDE the lock.  A router that touches the device under its own
+# lock, or an engine->router->engine call chain that closes a lock
+# cycle on the escalation path, is exactly what R4 must flag.
+
+R4_CASCADE_BAD = """
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+import jax
+
+class CascadeRouter:
+    def __init__(self):
+        self._lock = make_lock("CascadeRouter._lock")
+        self.engine = None
+
+    def gate(self, dets):
+        with self._lock:
+            return jax.device_get(dets)
+
+    def record(self, req):
+        with self._lock:
+            return self.engine.escalate(req)
+
+class ServeEngine:
+    def __init__(self):
+        self._lock = make_lock("ServeEngine._lock")
+        self.router = None
+
+    def escalate(self, req):
+        with self._lock:
+            return self.router.record(req)
+"""
+
+R4_CASCADE_GOOD = """
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+import jax
+
+class CascadeRouter:
+    def __init__(self):
+        self._lock = make_lock("CascadeRouter._lock")
+        self.engine = None
+        self.escalations = 0
+
+    def gate(self, dets):
+        host = jax.device_get(dets)
+        with self._lock:
+            self.escalations += 1
+        return host
+
+    def route(self, req):
+        verdict = self.gate(req.dets)
+        self.engine.escalate(req)
+        return verdict
+
+class ServeEngine:
+    def __init__(self):
+        self._lock = make_lock("ServeEngine._lock")
+
+    def escalate(self, req):
+        with self._lock:
+            return True
+"""
+
+
+def test_r4_fires_on_cascade_device_gate_under_router_lock():
+    fs = run_rule(R4_CASCADE_BAD, LockOrder(),
+                  path="mx_rcnn_tpu/serve/cascade.py")
+    assert any(
+        f.scope == "CascadeRouter.gate" and "device" in f.message
+        for f in fs
+    )
+    assert any("cycle" in f.message for f in fs)
+
+
+def test_r4_silent_on_cascade_leaf_lock_counters():
+    assert run_rule(R4_CASCADE_GOOD, LockOrder(),
+                    path="mx_rcnn_tpu/serve/cascade.py") == []
+
+
+# R5 against the ISSUE 18 escalation lane: an escalated request popped
+# off the re-entry queue and then dropped on the drain flag loses the
+# caller's future forever — first-pass results were already discarded
+# by the gate, so nobody else will ever settle it.  The shipped path
+# checks drain-and-empty BEFORE the pop.
+
+R5_CASCADE_BAD = """
+class EscalationWorker:
+    def loop(self):
+        while True:
+            with self._cond:
+                req = self._escalation_queue.popleft()
+            if self._draining:
+                return
+            self._resubmit(req)
+"""
+
+R5_CASCADE_GOOD = """
+class EscalationWorker:
+    def loop(self):
+        while True:
+            with self._cond:
+                while not self._escalation_queue and not self._draining:
+                    self._cond.wait(0.05)
+                if not self._escalation_queue and self._draining:
+                    return
+                req = self._escalation_queue.popleft()
+            self._resubmit(req)
+"""
+
+
+def test_r5_fires_on_droppable_escalated_request():
+    fs = run_rule(R5_CASCADE_BAD, ExactlyOnce(),
+                  path="mx_rcnn_tpu/serve/cascade.py")
+    assert len(fs) == 1 and "`req`" in fs[0].message
+
+
+def test_r5_silent_on_escalation_pop_after_drain_check():
+    assert run_rule(R5_CASCADE_GOOD, ExactlyOnce(),
+                    path="mx_rcnn_tpu/serve/cascade.py") == []
+
+
+def test_cascade_artifact_schema_guard(tmp_path):
+    """BENCH_cascade_cpu.json must carry the five ISSUE 18 claims —
+    all true — plus the threshold-sweep evidence, the full
+    {box,mask} x {f32,bf16,int8} parity matrix, and the cascade metric
+    records."""
+    claims = {
+        "cost_reduction_ge_1p3x_at_matched_accuracy": True,
+        "full_escalation_byte_identical": True,
+        "zero_steady_state_recompiles": True,
+        "int8_parity_ok_box_and_mask": True,
+        "bf16_parity_ok_box_and_mask": True,
+    }
+    good = {
+        "records": [
+            {"metric": m, "value": 1}
+            for m in ("serve_cascade_cost_ms_per_image_matched",
+                      "serve_cascade_cost_reduction_x",
+                      "serve_cascade_accuracy_matched",
+                      "serve_cascade_escalation_rate_matched",
+                      "serve_cascade_parity_rungs_ok",
+                      "serve_cascade_int8_compression_x_box",
+                      "serve_cascade_steady_state_compile_misses")
+        ],
+        "report": {
+            "claims": dict(claims),
+            "sweep": [{"min_score": 0.0}, {"min_score": 0.6}],
+            "parity_matrix": [
+                {"family": f, "precision": p, "ok": True}
+                for f in ("box", "mask")
+                for p in ("f32", "bf16", "int8")
+            ],
+        },
+    }
+    art = tmp_path / "BENCH_cascade_cpu.json"
+    art.write_text(json.dumps(good))
+    assert check_bench_artifacts(tmp_path) == []
+
+    good["report"]["claims"]["cost_reduction_ge_1p3x_at_matched_accuracy"] = False
+    del good["report"]["claims"]["bf16_parity_ok_box_and_mask"]
+    good["report"]["sweep"] = good["report"]["sweep"][:1]
+    good["report"]["parity_matrix"] = good["report"]["parity_matrix"][1:]
+    good["records"] = good["records"][1:]
+    art.write_text(json.dumps(good))
+    errs = " | ".join(check_bench_artifacts(tmp_path))
+    assert "'cost_reduction_ge_1p3x_at_matched_accuracy' not true" in errs
+    assert "'bf16_parity_ok_box_and_mask' missing" in errs
+    assert "report.sweep missing" in errs
+    assert "parity_matrix must cover" in errs
+    assert "no record metric 'serve_cascade_cost_ms_per_image*'" in errs

@@ -380,7 +380,12 @@ class TestResponseCache:
         assert c.digest(a) != c.digest(a.reshape(4, 4))  # same bytes
         assert c.digest(a) != c.digest(a.astype(np.float64))
         assert c.digest(a) != c.digest(a + 1)
-        assert c.key_for(a, "det", 3) == ("det", 3, c.digest(a))
+        assert c.key_for(a, "det", 3) == ("det", 3, "f32", c.digest(a))
+        # precision joins the key (ISSUE 18): an int8 serving of the
+        # same family/version can never share bytes with the f32 one
+        assert c.key_for(a, "det", 3, "int8") == ("det", 3, "int8",
+                                                  c.digest(a))
+        assert c.key_for(a, "det", 3) != c.key_for(a, "det", 3, "int8")
 
     def test_lru_no_overwrite_invalidate(self):
         c = ResponseCache(capacity=2)
@@ -448,19 +453,15 @@ class TestResponseCache:
         assert any(k[1] == 2 for k in cache._entries)
 
 
-# ------------------------------------------------- bf16 serve-graph parity
-@pytest.mark.slow
-def test_bf16_parity_gate_and_precision_signatures():
-    """One real tiny model served at bf16: warmup must run the f32
-    detection-parity gate, pass it, and tag every compile signature with
-    the precision so f32/bf16 graphs can never collide in the cache."""
+# -------------------------------------- reduced-precision serve-graph parity
+def _tiny_box_model():
+    """One real tiny box model (shared by the bf16 and int8 rung tests)."""
     import dataclasses
 
     import jax
 
     from mx_rcnn_tpu.config import generate_config
     from mx_rcnn_tpu.models import build_model
-    from mx_rcnn_tpu.serve.runner import ServeRunner
 
     cfg = generate_config("resnet50", "PascalVOC")
     cfg = cfg.replace(
@@ -485,10 +486,34 @@ def test_bf16_parity_gate_and_precision_signatures():
         np.array([[64, 64, 1.0]], np.float32),
         train=False,
     )["params"]
+    return model, params, cfg
+
+
+def test_parity_reports_keyed_per_model_and_precision():
+    """:attr:`ServeRunner.parity` is keyed ``"model:precision"`` (ISSUE
+    18): an int8 report can never satisfy — or be clobbered by — the
+    bf16 gate for the same family."""
+    from mx_rcnn_tpu.serve.runner import ServeRunner
+
+    r = ServeRunner.__new__(ServeRunner)  # key scheme needs no device
+    assert r._parity_key("det", "bf16") == "det:bf16"
+    assert r._parity_key("det", "int8") == "det:int8"
+    assert r._parity_key("det", "bf16") != r._parity_key("det", "int8")
+    assert r._parity_key("det", "bf16") != r._parity_key("seg", "bf16")
+
+
+@pytest.mark.slow
+def test_bf16_parity_gate_and_precision_signatures():
+    """One real tiny model served at bf16: warmup must run the f32
+    detection-parity gate, pass it, and tag every compile signature with
+    the precision so f32/bf16 graphs can never collide in the cache."""
+    from mx_rcnn_tpu.serve.runner import ServeRunner
+
+    model, params, cfg = _tiny_box_model()
     runner = ServeRunner(model, params, cfg, max_batch=1,
                          deterministic=True, precision="bfloat16")
     runner.warmup()
-    report = runner.parity[runner.default_model]
+    report = runner.parity[f"{runner.default_model}:bf16"]
     assert report["checked"] and report["ok"]
     assert report["precision"] == "bf16"
     assert report["max_box_delta_px"] <= report["box_tol_px"]
@@ -502,3 +527,52 @@ def test_bf16_parity_gate_and_precision_signatures():
     f32_sigs = f32.compile_cache.snapshot()["signatures"]
     assert all("f32" in repr(s) for s in f32_sigs)
     assert not set(map(repr, sigs)) & set(map(repr, f32_sigs))
+
+
+@pytest.mark.slow
+def test_int8_parity_gate_and_broken_scale_fold_refused():
+    """The int8 rung on a real tiny model: warmup folds per-channel
+    scales at registry load, runs the same f32 detection-parity gate as
+    bf16, and tags compile signatures ``int8``; a deliberately broken
+    scale fold must be REFUSED by the gate, not served."""
+    import jax
+
+    from mx_rcnn_tpu.core.quantize import is_quantized_leaf
+    from mx_rcnn_tpu.serve.runner import PrecisionParityError, ServeRunner
+
+    model, params, cfg = _tiny_box_model()
+    runner = ServeRunner(model, params, cfg, max_batch=1,
+                         deterministic=True, precision="int8")
+    runner.warmup()
+    report = runner.parity[f"{runner.default_model}:int8"]
+    assert report["checked"] and report["ok"]
+    assert report["precision"] == "int8"
+    assert report["max_box_delta_px"] <= report["box_tol_px"]
+    assert report["max_score_delta"] <= report["score_tol"]
+    sigs = runner.compile_cache.snapshot()["signatures"]
+    assert sigs and all("int8" in repr(s) for s in sigs)
+    # the registry folds scales once per (model, version) and caches
+    reg = runner.registry
+    assert reg.quantized_tree(runner.default_model) is reg.quantized_tree(
+        runner.default_model
+    )
+    # a corrupted scale fold (one leaf's scales x64) fails the gate
+    broken = ServeRunner(model, params, cfg, max_batch=1,
+                         deterministic=True, precision="int8")
+    slot = broken._slot(broken.default_model)
+    hit = [False]
+
+    def corrupt(x):
+        if is_quantized_leaf(x) and not hit[0]:
+            hit[0] = True
+            return {"int8_q": x["int8_q"],
+                    "int8_scale": np.asarray(x["int8_scale"]) * 64.0}
+        return x
+
+    slot.predictor.params = jax.tree_util.tree_map(
+        corrupt, jax.device_get(slot.predictor.params),
+        is_leaf=is_quantized_leaf,
+    )
+    assert hit[0]
+    with pytest.raises(PrecisionParityError, match="int8"):
+        broken.check_parity()
