@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault serve-mask serve-scale swap rollout cascade slo poison pipeline elastic chaos integration-gate clean-native
+.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault serve-mask serve-scale serve-fleet swap rollout cascade slo poison pipeline elastic chaos integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -110,6 +110,17 @@ serve-mask:
 serve-scale:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve_scale \
 	      --out BENCH_serve_scale_cpu.json
+
+# multi-host fleet bench (ISSUE 19): a wire-protocol FleetGateway over
+# 1/2/4 backend engine PROCESSES (pipelined connection pools, host-
+# level health/hedging, requeue-never-drop) — N=1 gateway responses
+# byte-identical to the direct engine, near-linear aggregate imgs/s
+# scaling, and a SIGKILL chaos phase that loses zero requests with
+# surviving responses byte-identical to an unfaulted run; emits the
+# BENCH_serve_fleet_cpu.json artifact `make check` then guards
+serve-fleet:
+	JAX_PLATFORMS=cpu $(PY) bench.py --serve_fleet \
+	      --out BENCH_serve_fleet_cpu.json
 
 # fault-matrix serving bench (ISSUE 6): the same deterministic load
 # against a 3-replica health-gated pool under healthy / wedged-replica /

@@ -840,6 +840,186 @@ def bench_serve_overlap(
     return records, report
 
 
+def bench_serve_fleet(
+    requests_per_backend: int = 120,
+    concurrency_per_backend: int = 32,
+    service_ms: float = 50.0,
+    fleet_sizes=(1, 2, 4),
+):
+    """Multi-host serving fleet (ISSUE 19): a wire-protocol gateway
+    fanning traffic over N backend engine *processes*.
+
+    Four phases against stub backends whose device stall is a
+    calibrated sleep (the ``--serve_overlap`` discipline — measures the
+    serve path, not model FLOPs; digests are pure functions of pixels
+    so every identity check is exact):
+
+    1. direct in-process engine, the reference responses;
+    2. gateway over ONE backend process, same seed — responses must be
+       byte-identical to (1): the wire adds routing, never bytes;
+    3. weak-scaling sweep over ``fleet_sizes`` processes (requests and
+       concurrency scale with N) — aggregate imgs/s vs the 1-backend
+       gateway is the scale-out claim;
+    4. chaos — SIGKILL one of two backends mid-load: zero lost
+       requests, and every response byte-identical to the unfaulted
+       2-backend run (requeued work re-executes to the same bytes).
+    """
+    import threading
+
+    from mx_rcnn_tpu.serve import loadgen
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.fleet import (
+        FleetGateway,
+        _FleetStubRunner,
+        spawn_stub_backends,
+    )
+
+    sizes = ((24, 24), (32, 48))
+    n_req, conc = requests_per_backend, concurrency_per_backend
+
+    def run_gateway(n_backends: int, collect: bool,
+                    chaos_kill_at: float = 0.0):
+        procs = spawn_stub_backends(n_backends, service_ms=service_ms)
+        gw = FleetGateway(
+            [p.addr for p in procs], fail_threshold=2
+        ).start()
+        killer = None
+        if chaos_kill_at > 0.0:
+            killer = threading.Timer(chaos_kill_at, procs[0].kill)
+            killer.start()
+        try:
+            rep = loadgen.run_load(
+                gw, num_requests=n_req * n_backends,
+                concurrency=conc * n_backends, sizes=sizes, seed=0,
+                collect=collect,
+            )
+            rep["gateway"] = gw.snapshot()
+            rep["fleet"] = gw.fleet_snapshot()
+            return rep
+        finally:
+            if killer is not None:
+                killer.cancel()
+            gw.stop()
+            for p in procs:
+                p.stop()
+
+    # -- phase 1: the direct engine reference ------------------------
+    print("# fleet phase 1: direct in-process engine", flush=True)
+    engine = ServingEngine(
+        _FleetStubRunner(service_ms=service_ms), max_linger=0.004,
+        max_queue=512,
+    )
+    with engine:
+        direct = loadgen.run_load(
+            engine, num_requests=n_req, concurrency=conc, sizes=sizes,
+            seed=0, collect=True,
+        )
+
+    # -- phase 2 + 3: gateway sweep (N=1 doubles as the identity run) -
+    sweep = {}
+    for n in fleet_sizes:
+        print(f"# fleet phase 2/3: gateway over {n} backend "
+              f"process(es)", flush=True)
+        sweep[n] = run_gateway(n, collect=(n in (1, 2)))
+
+    def outcomes_ok(rep):
+        return rep["outcomes"]["ok"]
+
+    def results_identical(a, b, n_expect):
+        ra, rb = a["_results"], b["_results"]
+        if len(ra) != n_expect or len(rb) != n_expect:
+            return False
+        for i in range(n_expect):
+            ka, va = ra[i]
+            kb, vb = rb[i]
+            if ka != "ok" or kb != "ok" or not _dets_equal(va, vb):
+                return False
+        return True
+
+    n1_identical = results_identical(direct, sweep[1], n_req)
+
+    base_ips = sweep[1]["imgs_per_sec"]
+    scaling = [
+        {
+            "backends": n,
+            "imgs_per_sec": round(sweep[n]["imgs_per_sec"], 2),
+            "speedup_x": round(sweep[n]["imgs_per_sec"] / base_ips, 3),
+            "ok": outcomes_ok(sweep[n]),
+            "requests": n_req * n,
+        }
+        for n in fleet_sizes
+    ]
+
+    # -- phase 4: SIGKILL one of two backends mid-load ---------------
+    print("# fleet phase 4: chaos — SIGKILL one of 2 backends",
+          flush=True)
+    # kill ~25% into the unfaulted 2-backend wall time, while the
+    # victim still holds a full window of in-flight requests
+    kill_at = max(0.05, sweep[2]["wall_s"] * 0.25)
+    chaos = run_gateway(2, collect=True, chaos_kill_at=kill_at)
+    chaos_ok = outcomes_ok(chaos)
+    chaos_lost = n_req * 2 - chaos_ok
+    chaos_identical = results_identical(sweep[2], chaos, n_req * 2)
+    chaos_gw = chaos["gateway"]["gateway"]
+
+    claims = {
+        "n1_byte_identical": bool(n1_identical),
+        "scaling_2x": scaling[1]["speedup_x"] >= 1.7,
+        "scaling_4x": scaling[2]["speedup_x"] >= 3.0,
+        "chaos_zero_lost": chaos_lost == 0,
+        "chaos_byte_identical": bool(chaos_identical),
+    }
+
+    records = [
+        {"metric": f"serve_fleet_imgs_per_sec_{n}",
+         "value": round(sweep[n]["imgs_per_sec"], 2), "unit": "imgs/s",
+         "vs_baseline": None}
+        for n in fleet_sizes
+    ] + [
+        {"metric": "serve_fleet_speedup_2x",
+         "value": scaling[1]["speedup_x"], "unit": "x",
+         "vs_baseline": None},
+        {"metric": "serve_fleet_speedup_4x",
+         "value": scaling[2]["speedup_x"], "unit": "x",
+         "vs_baseline": None},
+        {"metric": "serve_fleet_n1_byte_identical",
+         "value": int(n1_identical), "unit": "bool", "vs_baseline": None},
+        {"metric": "serve_fleet_chaos_lost",
+         "value": chaos_lost, "unit": "requests", "vs_baseline": None},
+        {"metric": "serve_fleet_chaos_requeued",
+         "value": chaos_gw["requeued"], "unit": "requests",
+         "vs_baseline": None},
+        {"metric": "serve_fleet_chaos_byte_identical",
+         "value": int(chaos_identical), "unit": "bool",
+         "vs_baseline": None},
+        {"metric": "serve_fleet_chaos_hedged",
+         "value": chaos_gw["hedged"], "unit": "requests",
+         "vs_baseline": None},
+    ]
+    report = {
+        "stub": {"service_ms": service_ms,
+                 "requests_per_backend": n_req,
+                 "concurrency_per_backend": conc},
+        "scaling": scaling,
+        "chaos": {
+            "killed_at_s": round(kill_at, 3),
+            "ok": chaos_ok,
+            "lost": chaos_lost,
+            "requeued": chaos_gw["requeued"],
+            "hedged": chaos_gw["hedged"],
+            "abandoned": chaos_gw["abandoned"],
+            "byte_identical": bool(chaos_identical),
+            "links": chaos["gateway"]["links"],
+        },
+        "claims": claims,
+    }
+    # drop the replay payloads before the artifact is serialized
+    for rep in (direct, chaos, *sweep.values()):
+        rep.pop("_results", None)
+        rep.pop("_times", None)
+    return records, report
+
+
 def bench_serve_slo(
     network: str,
     probes: int = 5,
@@ -3310,6 +3490,22 @@ def main():
                     help="stub D2H fetch + host postprocess per batch "
                          "for --serve_overlap")
     ap.add_argument(
+        "--serve_fleet", action="store_true",
+        help="multi-host fleet bench (ISSUE 19): wire-protocol gateway "
+             "over N backend engine processes — N=1 byte-identity vs "
+             "the direct engine, weak-scaling imgs/s at 1/2/4 backends, "
+             "and a SIGKILL chaos phase (zero lost requests, surviving "
+             "responses byte-identical to an unfaulted run)",
+    )
+    ap.add_argument("--fleet_requests", type=int, default=120,
+                    help="requests PER BACKEND for --serve_fleet")
+    ap.add_argument("--fleet_concurrency", type=int, default=32,
+                    help="client concurrency per backend for "
+                         "--serve_fleet")
+    ap.add_argument("--fleet_service_ms", type=float, default=50.0,
+                    help="stub backend device stall per batch for "
+                         "--serve_fleet")
+    ap.add_argument(
         "--serve_scale", action="store_true",
         help="tenant-fair front door bench (ISSUE 16): aggressor/victim "
              "isolation under a 4x rate-limit blast, autoscaler-"
@@ -3557,6 +3753,19 @@ def main():
         records, report = bench_cascade(
             requests=args.cascade_requests,
             hard_pct=args.cascade_hard_pct,
+        )
+        for rec in records:
+            print(json.dumps(rec), flush=True)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"records": records, "report": report}, f, indent=1)
+        return
+
+    if args.serve_fleet:
+        records, report = bench_serve_fleet(
+            requests_per_backend=args.fleet_requests,
+            concurrency_per_backend=args.fleet_concurrency,
+            service_ms=args.fleet_service_ms,
         )
         for rec in records:
             print(json.dumps(rec), flush=True)

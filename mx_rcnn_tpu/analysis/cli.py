@@ -437,6 +437,74 @@ def _check_cascade_schema(name: str, doc: dict) -> List[str]:
     return errors
 
 
+# multi-host fleet bench (ISSUE 19): the artifact must prove the
+# scale-out story end to end — N=1 gateway responses byte-identical to
+# the direct engine (the wire adds routing, never bytes), >=1.7x/>=3x
+# aggregate imgs/s at 2/4 backend processes, and the SIGKILL chaos
+# phase losing zero requests with surviving responses byte-identical
+# to an unfaulted run — plus the per-size scaling evidence and the
+# chaos accounting (lost/requeued) the claims rest on.
+_FLEET_CLAIMS = (
+    "n1_byte_identical",
+    "scaling_2x",
+    "scaling_4x",
+    "chaos_zero_lost",
+    "chaos_byte_identical",
+)
+
+_FLEET_METRIC_PREFIXES = (
+    "serve_fleet_imgs_per_sec",
+    "serve_fleet_speedup_2x",
+    "serve_fleet_speedup_4x",
+    "serve_fleet_n1_byte_identical",
+    "serve_fleet_chaos_lost",
+    "serve_fleet_chaos_requeued",
+    "serve_fleet_chaos_byte_identical",
+)
+
+
+def _check_fleet_schema(name: str, doc: dict) -> List[str]:
+    errors = []
+    report = doc.get("report") if isinstance(doc, dict) else None
+    if not isinstance(report, dict):
+        return [f"bench artifact {name}: missing report object"]
+    claims = report.get("claims")
+    if not isinstance(claims, dict):
+        return [f"bench artifact {name}: report.claims missing"]
+    for c in _FLEET_CLAIMS:
+        if c not in claims:
+            errors.append(f"bench artifact {name}: claim '{c}' missing")
+        elif claims[c] is not True:
+            errors.append(f"bench artifact {name}: claim '{c}' not true")
+    scaling = report.get("scaling")
+    if not isinstance(scaling, list) or not {
+        r.get("backends") for r in scaling if isinstance(r, dict)
+    } >= {1, 2, 4}:
+        errors.append(
+            f"bench artifact {name}: report.scaling must cover 1/2/4 "
+            f"backends — the speedup claims have no sweep evidence"
+        )
+    chaos = report.get("chaos")
+    if not isinstance(chaos, dict) or not {
+        "lost", "requeued", "byte_identical"
+    } <= set(chaos):
+        errors.append(
+            f"bench artifact {name}: report.chaos incomplete — the "
+            f"zero-loss claim has no kill-phase accounting"
+        )
+    metrics = {
+        r.get("metric", "")
+        for r in doc.get("records", [])
+        if isinstance(r, dict)
+    }
+    for prefix in _FLEET_METRIC_PREFIXES:
+        if not any(m.startswith(prefix) for m in metrics):
+            errors.append(
+                f"bench artifact {name}: no record metric '{prefix}*'"
+            )
+    return errors
+
+
 def check_bench_artifacts(root: Path) -> List[str]:
     errors = []
     for f in sorted(root.glob("BENCH_*.json")):
@@ -464,6 +532,8 @@ def check_bench_artifacts(root: Path) -> List[str]:
             errors += _check_rollout_schema(f.name, doc)
         if f.name == "BENCH_cascade_cpu.json":
             errors += _check_cascade_schema(f.name, doc)
+        if f.name == "BENCH_serve_fleet_cpu.json":
+            errors += _check_fleet_schema(f.name, doc)
     return errors
 
 

@@ -20,6 +20,10 @@ weighted-fair release + shed-over-budget-first), ``frontend`` (the
 length-prefixed wire protocol with a typed error taxonomy), and
 ``autoscaler`` (elastic replica count with a flap breaker and zero-loss
 scale-down).
+ISSUE 19 scales above the host: ``fleet`` runs a wire-protocol
+``FleetGateway`` over N backend engine *processes* — pipelined
+connection pools, host-level health/hedging/requeue-never-drop, and
+fleet-merged snapshots.
 See SERVING.md for the architecture and failure semantics.
 """
 
@@ -36,7 +40,20 @@ from mx_rcnn_tpu.serve.engine import (
     EngineStopped,
     ServingEngine,
 )
-from mx_rcnn_tpu.serve.metrics import LatencyHistogram, ServeMetrics
+from mx_rcnn_tpu.serve.fleet import (
+    BackendProc,
+    BadWireVersion,
+    FleetGateway,
+    InvalidWireFrame,
+    NoHealthyBackend,
+    launch_backends,
+    spawn_stub_backends,
+)
+from mx_rcnn_tpu.serve.metrics import (
+    LatencyHistogram,
+    ServeMetrics,
+    merge_snapshots,
+)
 from mx_rcnn_tpu.serve.registry import (
     DEFAULT_MODEL,
     ModelRegistry,
@@ -68,6 +85,8 @@ from mx_rcnn_tpu.serve.tenancy import (
 
 __all__ = [
     "AutoScaler",
+    "BackendProc",
+    "BadWireVersion",
     "BucketLadder",
     "BucketOverflow",
     "CompileCache",
@@ -75,12 +94,15 @@ __all__ = [
     "DeadlineExceeded",
     "DynamicBatcher",
     "EngineStopped",
+    "FleetGateway",
     "Frontend",
     "FrontendClient",
     "HealthPolicy",
+    "InvalidWireFrame",
     "LatencyHistogram",
     "ModelRegistry",
     "ModelVersion",
+    "NoHealthyBackend",
     "NoHealthyReplica",
     "QueueFull",
     "RegistryError",
@@ -106,4 +128,7 @@ __all__ = [
     "UnknownTenant",
     "VersionState",
     "WeightedFairScheduler",
+    "launch_backends",
+    "merge_snapshots",
+    "spawn_stub_backends",
 ]

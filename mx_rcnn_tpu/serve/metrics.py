@@ -26,6 +26,35 @@ import numpy as np
 from mx_rcnn_tpu.analysis.lockcheck import make_lock
 
 
+def merge_snapshots(snaps) -> Dict:
+    """Merge JSON-safe snapshot dicts from N workers into one fleet
+    view: numeric leaves SUM (counters and accumulated seconds — the
+    same additive convention :meth:`LatencyHistogram.merge` uses for
+    bins), nested dicts merge recursively, and non-numeric leaves
+    (ports, states, version strings) keep the first worker's value.
+    Adds ``n_sources`` at the top level so a reader can turn sums back
+    into per-worker means."""
+    snaps = [s for s in snaps if isinstance(s, dict)]
+
+    def _merge(a, b):
+        if isinstance(a, dict) and isinstance(b, dict):
+            out = dict(a)
+            for k, v in b.items():
+                out[k] = _merge(out[k], v) if k in out else v
+            return out
+        num_a = isinstance(a, (int, float)) and not isinstance(a, bool)
+        num_b = isinstance(b, (int, float)) and not isinstance(b, bool)
+        if num_a and num_b:
+            return a + b
+        return a  # shape mismatch or non-numeric: first worker wins
+
+    merged: Dict = {}
+    for s in snaps:
+        merged = _merge(merged, s) if merged else dict(s)
+    merged["n_sources"] = len(snaps)
+    return merged
+
+
 class LatencyHistogram:
     """Log-spaced latency histogram, milliseconds domain.
 

@@ -124,6 +124,73 @@ def load_model_source(src: str, default_network: str, small: bool,
     return model, cfg, params, man.get("checksum")
 
 
+def run_fleet(p, args):
+    """--fleet N: spawn N backend processes (this same command with
+    --backend), put a :class:`FleetGateway` over them, and drive the
+    load through the gateway — the multi-host serve path with the real
+    model stack in every process."""
+    import sys
+
+    from mx_rcnn_tpu.serve.fleet import FleetGateway, launch_backends
+    from mx_rcnn_tpu.serve.loadgen import run_load as _run_load
+
+    # children re-run this exact command line minus the fleet/output
+    # flags, plus --backend (they serve; only the parent drives load)
+    child = [sys.executable, "-m", "mx_rcnn_tpu.tools.serve"]
+    skip_next = False
+    for a in sys.argv[1:]:
+        if skip_next:
+            skip_next = False
+            continue
+        if a in ("--fleet", "--out", "--port_file"):
+            skip_next = True
+            continue
+        if a.startswith(("--fleet=", "--out=", "--port_file=")):
+            continue
+        child.append(a)
+    child.append("--backend")
+    logger.info("spawning %d backend process(es)...", args.fleet)
+    backends = launch_backends(child, args.fleet)
+    # real-model forwards run seconds on CPU: a stub-scale hedge clock
+    # would double-dispatch every request, so hedge late here
+    gw = FleetGateway(
+        [b.addr for b in backends], hedge_timeout=30.0
+    ).start()
+    sizes = ((72, 96), (96, 128), (64, 80)) if args.small else DEFAULT_SIZES
+    tenant_names = [
+        spec.partition("=")[0] for spec in args.tenant
+    ] or None
+    load_models = None
+    if args.model:
+        load_models = [None] + [
+            spec.partition("=")[0] for spec in args.model
+        ]
+    try:
+        report = _run_load(
+            gw,
+            num_requests=args.requests,
+            concurrency=args.concurrency,
+            sizes=sizes,
+            seed=args.seed,
+            deadline_s=(
+                args.deadline_ms / 1000.0
+                if args.deadline_ms is not None else None
+            ),
+            models=load_models,
+            tenants=tenant_names,
+        )
+        report["fleet"] = gw.fleet_snapshot()
+    finally:
+        gw.stop()
+        for b in backends:
+            b.stop()
+    print(json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+        logger.info("wrote %s", args.out)
+
+
 def main():
     from mx_rcnn_tpu.utils.platform import cli_bootstrap
 
@@ -211,8 +278,24 @@ def main():
                    help="also serve the length-prefixed wire protocol on "
                    "127.0.0.1:P for the duration of the load (0 = pick an "
                    "ephemeral port)")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="multi-host mode (ISSUE 19): spawn N backend "
+                   "PROCESSES (each re-running this command with "
+                   "--backend, full model stack per process), put a "
+                   "FleetGateway over them, and drive the load through "
+                   "the gateway")
+    p.add_argument("--backend", action="store_true",
+                   help="run as one fleet backend: build the configured "
+                   "engine, serve the wire protocol, announce the port, "
+                   "and block until stdin closes (no load generation)")
+    p.add_argument("--port_file", default=None,
+                   help="(backend mode) write the bound frontend port "
+                   "here — how a spawning gateway finds this process")
     p.add_argument("--out", default=None, help="write the report JSON here")
     args = p.parse_args()
+
+    if args.fleet > 0:
+        return run_fleet(p, args)
 
     if args.small:
         cfg = small_config(args.network)
@@ -351,12 +434,38 @@ def main():
                 max_replicas=args.autoscale_max,
             ))
         frontend = None
-        if args.frontend_port is not None:
+        if args.backend or args.frontend_port is not None:
             from mx_rcnn_tpu.serve.frontend import Frontend
 
-            frontend = Frontend(engine, port=args.frontend_port)
+            frontend = Frontend(
+                engine,
+                port=(args.frontend_port
+                      if args.frontend_port is not None else 0),
+            )
             frontend.start()
             logger.info("frontend listening on 127.0.0.1:%d", frontend.port)
+        if args.backend:
+            # fleet backend: announce the port, serve until the spawning
+            # gateway closes our stdin (SIGKILL needs no cooperation —
+            # that's the chaos path)
+            import os
+            import sys
+
+            print(f"FLEET_BACKEND port={frontend.port}", flush=True)
+            if args.port_file:
+                tmp = args.port_file + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(f"{frontend.port}\n")
+                os.replace(tmp, args.port_file)
+            try:
+                sys.stdin.read()
+            except KeyboardInterrupt:
+                pass
+            frontend.stop()
+            engine.stop()  # idempotent — the with-exit becomes a no-op
+            if hasattr(runner, "close"):
+                runner.close()
+            return
         swapper = None
         if args.swap:
             swapper = threading.Thread(target=run_swap, name="admin-swap")

@@ -1506,3 +1506,170 @@ def test_cascade_artifact_schema_guard(tmp_path):
     assert "report.sweep missing" in errs
     assert "parity_matrix must cover" in errs
     assert "no record metric 'serve_cascade_cost_ms_per_image*'" in errs
+
+
+# R4 against the ISSUE 19 fleet gateway: the gateway routes by calling
+# into per-backend links, each with its own lock.  Calling a link
+# method while holding the gateway lock (or an upcall re-entering the
+# gateway under the link lock) closes a gateway->link->gateway cycle —
+# the reader thread's response upcall then deadlocks against a
+# concurrent submit.  The shipped code computes routing state under
+# the gateway lock but always DISPATCHES and upcalls with no lock held.
+
+R4_FLEET_BAD = """
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+
+class BackendLink:
+    def __init__(self):
+        self._lock = make_lock("BackendLink._lock")
+        self.gw = None
+
+    def on_response(self, resp):
+        with self._lock:
+            return self.gw.finish(resp)
+
+class Gateway:
+    def __init__(self):
+        self._lock = make_lock("Gateway._lock")
+        self.links = [BackendLink()]
+
+    def finish(self, resp):
+        with self._lock:
+            return resp
+
+    def route(self, req):
+        with self._lock:
+            return self.links[0].on_response(req)
+"""
+
+R4_FLEET_GOOD = """
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+
+class BackendLink:
+    def __init__(self):
+        self._lock = make_lock("BackendLink._lock")
+        self.gw = None
+        self.completed = 0
+
+    def on_response(self, resp):
+        with self._lock:
+            self.completed += 1
+        self.gw.finish(resp)
+
+class Gateway:
+    def __init__(self):
+        self._lock = make_lock("Gateway._lock")
+        self.links = [BackendLink()]
+        self.routed = 0
+
+    def finish(self, resp):
+        with self._lock:
+            self.routed += 1
+
+    def route(self, req):
+        with self._lock:
+            target = self.links[0]
+        target.on_response(req)
+"""
+
+
+def test_r4_fires_on_gateway_link_lock_cycle():
+    fs = run_rule(R4_FLEET_BAD, LockOrder(),
+                  path="mx_rcnn_tpu/serve/fleet.py")
+    assert any("cycle" in f.message for f in fs)
+
+
+def test_r4_silent_on_lockless_gateway_dispatch():
+    assert run_rule(R4_FLEET_GOOD, LockOrder(),
+                    path="mx_rcnn_tpu/serve/fleet.py") == []
+
+
+# R5 against the fleet connection pool: a response popped off the
+# in-flight correlation map and then dropped on the stopping flag
+# strands the caller's future forever — the backend already answered,
+# so no requeue path will ever touch that request again.  The shipped
+# reader hands EVERY popped entry to the link upcall.
+
+R5_FLEET_BAD = """
+class ConnReader:
+    def loop(self):
+        while True:
+            resp = self.read_frame()
+            with self._lock:
+                entry = self.pending.get(resp["id"])
+            if self._stopping:
+                return
+            self.owner.on_response(entry, resp)
+"""
+
+R5_FLEET_GOOD = """
+class ConnReader:
+    def loop(self):
+        while True:
+            resp = self.read_frame()
+            with self._lock:
+                entry = self.pending.get(resp["id"])
+            if entry is not None:
+                self.owner.on_response(entry, resp)
+"""
+
+
+def test_r5_fires_on_droppable_correlated_response():
+    fs = run_rule(R5_FLEET_BAD, ExactlyOnce(),
+                  path="mx_rcnn_tpu/serve/fleet.py")
+    assert len(fs) == 1 and "`entry`" in fs[0].message
+
+
+def test_r5_silent_on_response_always_handed_off():
+    assert run_rule(R5_FLEET_GOOD, ExactlyOnce(),
+                    path="mx_rcnn_tpu/serve/fleet.py") == []
+
+
+def test_fleet_artifact_schema_guard(tmp_path):
+    """BENCH_serve_fleet_cpu.json must carry the five ISSUE 19 claims
+    — all true — plus the 1/2/4-backend scaling sweep and the chaos
+    kill-phase accounting."""
+    claims = {
+        "n1_byte_identical": True,
+        "scaling_2x": True,
+        "scaling_4x": True,
+        "chaos_zero_lost": True,
+        "chaos_byte_identical": True,
+    }
+    good = {
+        "records": [
+            {"metric": m, "value": 1}
+            for m in ("serve_fleet_imgs_per_sec_1",
+                      "serve_fleet_speedup_2x",
+                      "serve_fleet_speedup_4x",
+                      "serve_fleet_n1_byte_identical",
+                      "serve_fleet_chaos_lost",
+                      "serve_fleet_chaos_requeued",
+                      "serve_fleet_chaos_byte_identical")
+        ],
+        "report": {
+            "claims": dict(claims),
+            "scaling": [
+                {"backends": n, "imgs_per_sec": 100.0 * n,
+                 "speedup_x": float(n)}
+                for n in (1, 2, 4)
+            ],
+            "chaos": {"lost": 0, "requeued": 3, "byte_identical": True},
+        },
+    }
+    art = tmp_path / "BENCH_serve_fleet_cpu.json"
+    art.write_text(json.dumps(good))
+    assert check_bench_artifacts(tmp_path) == []
+
+    good["report"]["claims"]["chaos_zero_lost"] = False
+    del good["report"]["claims"]["scaling_4x"]
+    good["report"]["scaling"] = good["report"]["scaling"][:2]
+    del good["report"]["chaos"]["requeued"]
+    good["records"] = good["records"][1:]
+    art.write_text(json.dumps(good))
+    errs = " | ".join(check_bench_artifacts(tmp_path))
+    assert "'chaos_zero_lost' not true" in errs
+    assert "'scaling_4x' missing" in errs
+    assert "report.scaling must cover 1/2/4" in errs
+    assert "report.chaos incomplete" in errs
+    assert "no record metric 'serve_fleet_imgs_per_sec*'" in errs

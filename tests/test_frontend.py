@@ -516,3 +516,173 @@ class TestRolloutErrorTaxonomy:
         with FrontendClient("127.0.0.1", fe.port) as cli:
             again = cli.request(image(7), tenant="acme")
         assert again["ok"]
+
+
+# ------------------------------------------------------- ISSUE 19 wire
+class _PipelineClient:
+    """Raw-socket helper for the pipelined path: ships many id-tagged
+    frames before reading anything, then collects responses in arrival
+    order (which the protocol allows to differ from send order)."""
+
+    def __init__(self, host, port, timeout=30.0):
+        import socket as _socket
+
+        self.sock = _socket.create_connection((host, port),
+                                              timeout=timeout)
+
+    def send(self, im, rid, tenant="acme", **over):
+        import json
+
+        header = {
+            "v": 1, "id": rid, "tenant": tenant,
+            "dtype": im.dtype.name, "shape": list(im.shape),
+        }
+        header.update(over)
+        payload = json.dumps(header).encode() + b"\n" + im.tobytes()
+        self.sock.sendall(_LEN.pack(len(payload)) + payload)
+
+    def recv(self):
+        import json
+
+        from mx_rcnn_tpu.serve.frontend import _read_exact
+
+        hdr = _read_exact(self.sock, _LEN.size)
+        if hdr is None:
+            raise ConnectionError("closed")
+        (length,) = _LEN.unpack(hdr)
+        body = _read_exact(self.sock, length)
+        if body is None:
+            raise ConnectionError("closed mid-frame")
+        return json.loads(body.decode())
+
+    def close(self):
+        self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class TestWireVersionAndPipelining:
+    """ISSUE 19 satellites: the ``v`` version gate, id-correlated
+    pipelining, admin ops, and the half-open-client guards."""
+
+    def test_bad_version_typed_reject_connection_survives(
+            self, served_engine):
+        _, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            resp = cli.send_raw(good_header(v=99) + b"\x00" * 12)
+            assert resp["ok"] is False
+            assert resp["error"] == "bad_version"
+            # version mismatch is a per-frame verdict, not a hangup
+            again = cli.request(image(11), tenant="acme")
+            assert again["ok"]
+        assert fe.errors["bad_version"] == 1
+
+    def test_headers_without_version_still_served(self, served_engine):
+        # legacy clients (pre-v field) keep working
+        _, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            resp = cli.send_raw(good_header() + b"\x00" * 12)
+        assert resp["ok"]
+
+    def test_bad_version_on_pipelined_frame_echoes_id(
+            self, served_engine):
+        _, fe = served_engine
+        with _PipelineClient("127.0.0.1", fe.port) as cli:
+            cli.send(image(12, 2, 2), rid=5, v=99)
+            resp = cli.recv()
+        assert resp["error"] == "bad_version"
+        assert resp["id"] == 5
+
+    def test_pipelined_ids_correlate_out_of_order(self, served_engine):
+        engine, fe = served_engine
+        n = 6
+        imgs = {rid: image(rid, 2, 2) for rid in range(n)}
+        with _PipelineClient("127.0.0.1", fe.port) as cli:
+            for rid, im in imgs.items():
+                cli.send(im, rid)
+            got = {}
+            for _ in range(n):
+                resp = cli.recv()
+                assert resp["ok"], resp
+                got[resp["id"]] = resp
+        assert set(got) == set(imgs)
+        # responses carry the digest of THEIR request, whatever the
+        # arrival order was
+        from mx_rcnn_tpu.serve.frontend import decode_detections
+
+        for rid, im in imgs.items():
+            ref = engine.submit(im, tenant="acme").result(timeout=10.0)
+            dets = decode_detections(got[rid]["detections"],
+                                     got[rid].get("det_meta"))
+            assert dets[0].tobytes() == ref[0].tobytes()
+        assert fe.snapshot()["pipelined"] == n
+
+    def test_pipelined_id_must_be_int(self, served_engine):
+        _, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            resp = cli.send_raw(good_header(id="seven") + b"\x00" * 12)
+        assert resp["ok"] is False
+        assert resp["error"] == "invalid_frame"
+
+    def test_op_ping(self, served_engine):
+        _, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            resp = cli.op("ping")
+        assert resp["ok"] and resp["op"] == "ping"
+
+    def test_op_snapshot_carries_engine_and_frontend(self, served_engine):
+        _, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            cli.request(image(13), tenant="acme")
+            resp = cli.op("snapshot")
+        assert resp["ok"] and resp["op"] == "snapshot"
+        assert resp["engine"]["requests"]["submitted"] >= 1
+        assert resp["frontend"]["frames"] >= 1
+
+    def test_unknown_op_rejected(self, served_engine):
+        _, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            resp = cli.op("reboot")
+        assert resp["ok"] is False
+        assert resp["error"] == "invalid_frame"
+
+    def test_idle_connection_reaped_and_counted(self):
+        engine = ServingEngine(FakeRunner(), max_linger=0.0)
+        with engine:
+            fe = Frontend(engine, conn_read_timeout=0.05)
+            fe.start()
+            try:
+                cli = FrontendClient("127.0.0.1", fe.port)
+                time.sleep(0.4)  # idle past the reaper deadline
+                with pytest.raises(ConnectionError):
+                    cli.request(image(14), tenant="t")
+                cli.close()
+                assert fe.snapshot()["conn_timeouts"] == 1
+            finally:
+                fe.stop()
+
+    def test_connection_cap_rejects_with_typed_code(self):
+        engine = ServingEngine(FakeRunner(), max_linger=0.0)
+        with engine:
+            fe = Frontend(engine, max_conns=1)
+            fe.start()
+            try:
+                keep = FrontendClient("127.0.0.1", fe.port)
+                # the cap counts registered conns; wait for the first
+                # to land before dialing the one that must be refused
+                t_end = time.time() + 5.0
+                while fe.accepted < 1 and time.time() < t_end:
+                    time.sleep(0.005)
+                over = FrontendClient("127.0.0.1", fe.port)
+                resp = over._recv()  # server speaks first: the reject
+                assert resp["ok"] is False
+                assert resp["error"] == "conn_limit"
+                over.close()
+                keep.close()
+                assert fe.snapshot()["conn_rejected"] == 1
+            finally:
+                fe.stop()
