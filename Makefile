@@ -7,7 +7,7 @@
 
 PY ?= python
 
-.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault serve-mask serve-scale serve-fleet swap rollout cascade slo poison pipeline elastic chaos integration-gate clean-native
+.PHONY: native test test-kernels test-fast lint check resilience bench bench-eval eval-bench serve serve-overlap serve-fault serve-mask streaming serve-scale serve-fleet swap rollout cascade slo poison pipeline elastic chaos integration-gate clean-native
 
 # compile native/hostops.c + native/rlelib.c into ~/.cache/mx_rcnn_tpu
 native:
@@ -100,6 +100,19 @@ serve-mask:
 	JAX_PLATFORMS=cpu $(PY) bench.py --serve_mask --serve_requests 24 \
 	      --serve_concurrency 6 --serve_max_batch 4 \
 	      --out BENCH_serve_mask_cpu.json
+
+# streaming-serve bench (ISSUE 20): device-side mask paste — survivors'
+# S×S grids resized/thresholded into their box footprints on the fixed
+# bucket canvas INSIDE the jit, so the host keeps only RLE.  Emits the
+# host-paste-ms/frame reduction at mask-flagship geometry (RLE
+# byte-identity vs the numpy fixed-point mirror), per-stream in-order
+# completion under the trip/stall chaos matrix with a mid-load hot-swap
+# (zero lost frames, bytes identical to the unfaulted run), the
+# zero-steady-state-recompile count, and the temporal-priming
+# recall/latency sweep, as the BENCH_streaming_cpu.json artifact
+streaming:
+	JAX_PLATFORMS=cpu $(PY) bench.py --streaming --serve_max_batch 4 \
+	      --out BENCH_streaming_cpu.json
 
 # tenant-fair front door bench (ISSUE 16): aggressor/victim isolation
 # with the aggressor blasting 4x its token-bucket rate (victim p99 must

@@ -505,6 +505,89 @@ def _check_fleet_schema(name: str, doc: dict) -> List[str]:
     return errors
 
 
+# streaming bench (ISSUE 20): the artifact must prove the streaming
+# closure — device-paste RLEs byte-identical to the host-paste path,
+# the >=5x host paste-ms/frame reduction at flagship geometry, zero
+# steady-state recompiles through warmup + hot-swap, per-stream
+# in-order completion with zero lost frames under the chaos matrix
+# with surviving bytes identical to the unfaulted run, and a monotone
+# priming recall/latency table — plus the paste-ms and ordering
+# evidence the claims rest on.
+_STREAMING_CLAIMS = (
+    "paste_rle_byte_identical",
+    "paste_reduction_ge_5x",
+    "zero_steady_state_recompiles",
+    "stream_in_order_under_chaos",
+    "chaos_bytes_identical",
+    "priming_monotone_tradeoff",
+)
+
+_STREAMING_METRIC_PREFIXES = (
+    "streaming_paste_host_ms_per_frame",
+    "streaming_paste_device_ms_per_frame",
+    "streaming_paste_reduction_x",
+    "streaming_paste_rle_byte_identical",
+    "streaming_steady_state_compile_misses",
+    "streaming_chaos_lost_frames",
+    "streaming_chaos_in_order",
+    "streaming_priming_recall_gain",
+)
+
+
+def _check_streaming_schema(name: str, doc: dict) -> List[str]:
+    errors = []
+    report = doc.get("report") if isinstance(doc, dict) else None
+    if not isinstance(report, dict):
+        return [f"bench artifact {name}: missing report object"]
+    claims = report.get("claims")
+    if not isinstance(claims, dict):
+        return [f"bench artifact {name}: report.claims missing"]
+    for c in _STREAMING_CLAIMS:
+        if c not in claims:
+            errors.append(f"bench artifact {name}: claim '{c}' missing")
+        elif claims[c] is not True:
+            errors.append(f"bench artifact {name}: claim '{c}' not true")
+    paste = report.get("paste")
+    if not isinstance(paste, dict) or not isinstance(
+        paste.get("stub"), dict
+    ) or not {
+        "host_paste_ms_per_frame", "device_paste_ms_per_frame",
+        "reduction_x",
+    } <= set(paste["stub"]):
+        errors.append(
+            f"bench artifact {name}: report.paste.stub incomplete — the "
+            f"paste-reduction claim has no measured ms evidence"
+        )
+    chaos = report.get("chaos")
+    if not isinstance(chaos, dict) or not all(
+        isinstance(s, dict) and {"in_order", "lost_frames"} <= set(s)
+        for s in chaos.values()
+    ) or len(chaos) < 2:
+        errors.append(
+            f"bench artifact {name}: report.chaos incomplete — the "
+            f"in-order claim has no per-scenario ordering evidence"
+        )
+    priming = report.get("priming")
+    if not isinstance(priming, dict) or not isinstance(
+        priming.get("table"), list
+    ) or len(priming["table"]) < 3:
+        errors.append(
+            f"bench artifact {name}: report.priming.table missing — the "
+            f"tradeoff claim has no sweep rows"
+        )
+    metrics = {
+        r.get("metric", "")
+        for r in doc.get("records", [])
+        if isinstance(r, dict)
+    }
+    for prefix in _STREAMING_METRIC_PREFIXES:
+        if not any(m.startswith(prefix) for m in metrics):
+            errors.append(
+                f"bench artifact {name}: no record metric '{prefix}*'"
+            )
+    return errors
+
+
 def check_bench_artifacts(root: Path) -> List[str]:
     errors = []
     for f in sorted(root.glob("BENCH_*.json")):
@@ -534,6 +617,8 @@ def check_bench_artifacts(root: Path) -> List[str]:
             errors += _check_cascade_schema(f.name, doc)
         if f.name == "BENCH_serve_fleet_cpu.json":
             errors += _check_fleet_schema(f.name, doc)
+        if f.name == "BENCH_streaming_cpu.json":
+            errors += _check_streaming_schema(f.name, doc)
     return errors
 
 

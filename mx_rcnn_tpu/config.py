@@ -103,6 +103,13 @@ class TestConfig:
     # selected grids cross — sigmoid/paste/RLE stay host-side.  False
     # restores the reference-style host loop
     DEVICE_POSTPROCESS: bool = True
+    # streaming mask serving (ISSUE 20): additionally paste each
+    # survivor's grid into a fixed (max_det, Hc, Wc) binary canvas
+    # inside the jit (Hc, Wc = padded bucket extent → one shape per
+    # rung, zero-recompile ladder intact) so the host keeps only RLE.
+    # Requires DEVICE_POSTPROCESS and a mask network; off by default —
+    # the detection-only eval path never pays for canvases
+    MASK_CANVAS: bool = False
     # ship eval images as uint8 and normalize on device — 4× less H2D
     # traffic for a ≤0.5-LSB quantization of the resized pixels
     UINT8_TRANSFER: bool = True

@@ -62,6 +62,16 @@ class Predictor:
             orig_hw = batch.pop("orig_hw", None)
             out = model.apply({"params": p}, train=False, **batch)
             if postprocess is not None and orig_hw is not None:
+                if getattr(postprocess, "wants_canvas", False):
+                    # canvas-paste postprocess (streaming mask serving):
+                    # the paste canvas is the padded bucket extent —
+                    # static under the trace, so one canvas shape per
+                    # (model, bucket) rung and the compile ladder is
+                    # untouched
+                    return postprocess(
+                        out, batch["im_info"], orig_hw,
+                        tuple(batch["images"].shape[1:3]),
+                    )
                 return postprocess(out, batch["im_info"], orig_hw)
             return out
 

@@ -88,6 +88,86 @@ def synthetic_image(rec: Dict, seed: int) -> np.ndarray:
     return im
 
 
+def moving_scene(
+    stream_seed: int,
+    num_frames: int,
+    image_size=(480, 640),
+    num_objects: int = 3,
+    num_classes: int = 21,
+    max_step: float = 8.0,
+    with_masks: bool = False,
+) -> List[Dict]:
+    """Deterministic moving scene for streaming serve (ISSUE 20): one
+    record per frame, same objects throughout, constant per-object
+    velocity with elastic bounces off the canvas edges.
+
+    Each record is ``synthetic_image``-renderable (its
+    ``synthetic_seed`` is a pure function of ``(stream_seed, frame)``,
+    so frame pixels are reproducible independently) and roidb-shaped
+    (``boxes``/``gt_classes``/``height``/``width``), so the priming
+    sweep can feed it straight to ``eval/recall.py::proposal_recall``.
+    Frame-to-frame box displacement is bounded by ``max_step`` pixels —
+    the temporal coherence that makes frame N−1's detections a useful
+    proposal seed for frame N."""
+    rng = np.random.RandomState(stream_seed)
+    h, w = image_size
+    sizes, vels, pos, classes, kinds = [], [], [], [], []
+    for _ in range(num_objects):
+        bw = rng.randint(60, w // 2)
+        bh = rng.randint(60, h // 2)
+        sizes.append((bw, bh))
+        pos.append((
+            float(rng.randint(0, w - bw)), float(rng.randint(0, h - bh))
+        ))
+        # uniform speed in [max_step/2, max_step], uniform heading —
+        # every object genuinely moves (a zero-velocity draw would make
+        # priming trivially perfect on that object)
+        speed = rng.uniform(max_step / 2.0, max_step)
+        theta = rng.uniform(0.0, 2.0 * np.pi)
+        vels.append((speed * np.cos(theta), speed * np.sin(theta)))
+        classes.append(int(rng.randint(1, num_classes)))
+        kinds.append(("ellipse", "triangle", "rect")[rng.randint(3)])
+    tris = [rng.uniform(0.25, 0.75) for _ in range(num_objects)]
+    frames = []
+    pos = [list(p) for p in pos]
+    vels = [list(v) for v in vels]
+    for f in range(num_frames):
+        boxes, segms = [], []
+        for i, (bw, bh) in enumerate(sizes):
+            x, y = pos[i]
+            x1, y1 = int(round(x)), int(round(y))
+            box = [x1, y1, x1 + bw - 1, y1 + bh - 1]
+            boxes.append(box)
+            if with_masks:
+                segms.append([shape_polygon(kinds[i], box, t=tris[i])])
+            # advance + bounce (reflect position AND velocity so the
+            # object stays fully inside the canvas)
+            for axis, extent, size in ((0, w, bw), (1, h, bh)):
+                p = pos[i][axis] + vels[i][axis]
+                if p < 0:
+                    p = -p
+                    vels[i][axis] = -vels[i][axis]
+                hi = extent - size
+                if p > hi:
+                    p = 2 * hi - p
+                    vels[i][axis] = -vels[i][axis]
+                pos[i][axis] = p
+        rec = {
+            "image": f"synthetic://stream{stream_seed}/{f}",
+            "height": h,
+            "width": w,
+            "boxes": np.asarray(boxes, np.float32),
+            "gt_classes": np.asarray(classes, np.int32),
+            "flipped": False,
+            "frame": f,
+            "synthetic_seed": stream_seed * 100003 + f,
+        }
+        if with_masks:
+            rec["segmentation"] = segms
+        frames.append(rec)
+    return frames
+
+
 class SyntheticDataset(IMDB):
     def __init__(
         self,

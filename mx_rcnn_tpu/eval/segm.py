@@ -42,6 +42,78 @@ def paste_mask(
     return out
 
 
+def paste_mask_canvas(
+    logits: np.ndarray, box: np.ndarray, hc: int, wc: int
+) -> np.ndarray:
+    """(S, S) LOGIT grid + CANVAS-space box → (hc, wc) u8 binary mask.
+
+    Numpy mirror of the device canvas paste
+    (``ops/postprocess.py :: make_test_postprocess(paste=True)``) —
+    every arithmetic step matches op-for-op: clip box to the canvas,
+    floor/ceil footprint (+1 convention), cv2-style half-pixel source
+    mapping, then a bilinear blend in int32 FIXED POINT (logits
+    quantized to 8 fractional bits, weights to 7) thresholded at logit
+    0 (= probability 0.5).  Integer arithmetic is exact on every
+    backend, so this function and the device canvas are bitwise equal
+    by construction — the streaming bench's RLE byte-identity bar.
+    """
+    s = logits.shape[0]
+    x1 = np.clip(np.float32(box[0]), 0.0, wc - 1.0)
+    y1 = np.clip(np.float32(box[1]), 0.0, hc - 1.0)
+    x2 = np.clip(np.float32(box[2]), 0.0, wc - 1.0)
+    y2 = np.clip(np.float32(box[3]), 0.0, hc - 1.0)
+    x1i = int(np.floor(x1))
+    y1i = int(np.floor(y1))
+    x2i = int(np.ceil(x2))
+    y2i = int(np.ceil(y2))
+    bw = max(x2i - x1i + 1, 1)
+    bh = max(y2i - y1i + 1, 1)
+    q = np.round(
+        np.clip(logits.astype(np.float32), -60.0, 60.0) * np.float32(256.0)
+    ).astype(np.int32)
+
+    def axis(n):
+        t = (np.arange(n, dtype=np.float32) + np.float32(0.5)) \
+            * np.float32(s) / np.float32(n) - np.float32(0.5)
+        sc = np.clip(t, 0.0, s - 1.0).astype(np.float32)
+        i0 = np.floor(sc).astype(np.int32)
+        i1 = np.minimum(i0 + 1, s - 1)
+        w = np.round(
+            (sc - i0.astype(np.float32)) * np.float32(128.0)
+        ).astype(np.int32)
+        return i0, i1, w
+
+    x0, x1b, wx = axis(bw)
+    y0, y1b, wy = axis(bh)
+    val = (128 - wy)[:, None] * (
+        (128 - wx)[None, :] * q[y0][:, x0] + wx[None, :] * q[y0][:, x1b]
+    ) + wy[:, None] * (
+        (128 - wx)[None, :] * q[y1b][:, x0] + wx[None, :] * q[y1b][:, x1b]
+    )
+    out = np.zeros((hc, wc), np.uint8)
+    out[y1i : y2i + 1, x1i : x2i + 1] = (val >= 0).astype(np.uint8)
+    return out
+
+
+def canvas_rles(
+    grids: np.ndarray, dets: np.ndarray, scale: float, hc: int, wc: int
+) -> list:
+    """One class's (n, S, S) LOGIT grids + (n, 5) ORIGINAL-coordinate
+    detections → list of CANVAS-space RLEs (the host half of the
+    streaming mask contract when the device canvas is off).  Boxes map
+    to canvas coordinates by the image scale, exactly as on device."""
+    from mx_rcnn_tpu.native import rle
+
+    return [
+        rle.encode(
+            paste_mask_canvas(
+                g, np.asarray(d[:4], np.float32) * np.float32(scale), hc, wc
+            )
+        )
+        for g, d in zip(grids, dets)
+    ]
+
+
 def mask_to_rle(mask_prob: np.ndarray, box: np.ndarray, h: int, w: int,
                 thresh: float = 0.5) -> Dict:
     """Probability grid + box → image-space RLE dict."""

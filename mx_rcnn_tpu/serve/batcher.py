@@ -119,6 +119,16 @@ class Request:
     cascade: bool = False
     escalated: bool = False
     raw_image: Optional["np.ndarray"] = None
+    # streaming mode (ISSUE 20): frames of one stream are submitted in
+    # order and DELIVERED in order (engine StreamTable gate); the
+    # batcher additionally keeps them dispatch-ordered within a group —
+    # a requeued earlier frame re-enters AHEAD of queued later frames
+    # of the same stream (see submit)
+    stream: Optional[str] = None
+    frame: Optional[int] = None
+    # streaming mask serving: resolve to (cls_dets, rles) via the
+    # runner's canvas-RLE path instead of plain detections
+    masks: bool = False
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
@@ -168,6 +178,7 @@ class DynamicBatcher:
         self.preemptions = 0        # interactive released while bulk waited
         self.aged_releases = 0      # bulk released via the aging guard
         self.expired_swept = 0      # dead requests removed pre-pickup
+        self.stream_reinserts = 0   # stream frames slotted ahead on re-entry
         self.released = {lane: 0 for lane in LANES}  # batches per lane
         self.released_by_tenant: Dict[Optional[str], int] = {}  # requests
 
@@ -197,9 +208,27 @@ class DynamicBatcher:
                 req.enqueue_t = time.monotonic()
             if req.lane not in LANES:
                 raise ValueError(f"unknown SLO lane {req.lane!r}")
-            self._queues.setdefault(
+            q = self._queues.setdefault(
                 (req.model, req.bucket, req.lane, req.tenant), deque()
-            ).append(req)
+            )
+            pos = None
+            if req.stream is not None and req.frame is not None and q:
+                # per-stream dispatch order (ISSUE 20): a re-entering
+                # earlier frame (containment resubmit, cascade
+                # escalation) slots in BEFORE queued later frames of
+                # its stream, so the stream's delivery gate never has
+                # to buffer behind a frame the scheduler put last
+                pos = next(
+                    (i for i, r in enumerate(q)
+                     if r.stream == req.stream and r.frame is not None
+                     and r.frame > req.frame),
+                    None,
+                )
+            if pos is None:
+                q.append(req)
+            else:
+                q.insert(pos, req)
+                self.stream_reinserts += 1
             self._count += 1
             self._cond.notify()
 
@@ -385,6 +414,7 @@ class DynamicBatcher:
                 "preemptions": self.preemptions,
                 "aged_releases": self.aged_releases,
                 "expired_swept": self.expired_swept,
+                "stream_reinserts": self.stream_reinserts,
                 "batches_by_lane": dict(self.released),
             }
             if self.released_by_tenant:

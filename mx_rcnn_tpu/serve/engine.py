@@ -68,6 +68,7 @@ from mx_rcnn_tpu.serve.quarantine import (
     validate_image,
 )
 from mx_rcnn_tpu.serve.runner import ServeRunner
+from mx_rcnn_tpu.serve.streams import StreamTable
 
 # DeadlineExceeded historically lived here; it moved to serve.batcher so
 # the expired-request sweep can raise it without a circular import, and
@@ -158,6 +159,12 @@ class ServingEngine:
         # cheap family, _complete runs the gate and escalates uncertain
         # first passes back through the batcher as flagship requests
         self.cascade = None
+        # streaming mode (ISSUE 20): per-stream in-order delivery gate
+        # at _resolve — the exactly-once choke point every redispatch
+        # path (trip/requeue/hedge/resubmit/escalation) funnels through,
+        # so frames of one stream complete in order no matter how they
+        # executed.  Untagged requests bypass it entirely.
+        self.streams = StreamTable()
         # every not-yet-resolved request, so stop() can sweep leftovers
         # with a terminal EngineStopped instead of stranding submitters
         self._live: Dict[int, Request] = {}
@@ -220,6 +227,11 @@ class ServingEngine:
         if self._pool is not None:
             self._pool.close(raise_errors=False)
         self._started = False
+        # results already settled but parked behind a stream gap must
+        # ship before the leftover sweep fails their successors — no
+        # settled result is ever lost to a stop (ordering is best-effort
+        # at teardown: the gap frames resolve EngineStopped below)
+        self.streams.flush()
         with self._live_lock:
             leftovers = list(self._live.values())
             self._live.clear()
@@ -357,6 +369,31 @@ class ServingEngine:
         except Exception:  # noqa: BLE001 — no live version = no caching
             return None
 
+    def _stream_admit(self, stream, frame) -> bool:
+        """Validate + register a streaming submit's ``(stream, frame)``
+        identity; True when registered (the caller must cancel on any
+        later synchronous rejection, or the permanent gap would buffer
+        the stream's later frames forever)."""
+        if stream is None and frame is None:
+            return False
+        if stream is None or frame is None:
+            self.metrics.inc("invalid")
+            self.metrics.inc("rejected")
+            raise InvalidRequest(
+                "stream and frame must be provided together"
+            )
+        try:
+            self.streams.register(stream, frame)
+        except (TypeError, ValueError) as e:
+            self.metrics.inc("invalid")
+            self.metrics.inc("rejected")
+            raise InvalidRequest(f"bad stream/frame: {e}")
+        return True
+
+    def _stream_cancel(self, stream, frame) -> None:
+        if stream is not None and frame is not None:
+            self.streams.cancel(stream, int(frame))
+
     def submit(
         self,
         im: np.ndarray,
@@ -364,13 +401,26 @@ class ServingEngine:
         model: Optional[str] = None,
         lane: Optional[str] = None,
         tenant: Optional[str] = None,
+        stream: Optional[str] = None,
+        frame: Optional[int] = None,
+        masks: bool = False,
     ) -> Future:
         """Enqueue one image; returns a Future resolving to the
         per-class detections list.  ``model`` selects a registry family
         (None = the default model — the tenancy request schema);
         ``lane`` tags the SLO class (``"interactive"`` | ``"bulk"``,
         None = the model's registry default); ``tenant`` is the fair-
-        share identity (None = untagged in-process caller).  Raises
+        share identity (None = untagged in-process caller).
+
+        Streaming mode (ISSUE 20): ``stream``/``frame`` (always
+        together; ``frame`` strictly increasing per stream) put the
+        request under the per-stream in-order delivery guarantee —
+        frames of one stream resolve in frame order no matter how
+        trips, requeues, hedges, or escalations reorder execution;
+        cross-stream and untagged traffic is unordered and unaffected.
+        ``masks=True`` resolves to ``(cls_dets, rles)`` — canvas-space
+        mask RLEs from the runner's device-paste path (requires a mask
+        model family).  Raises
         :class:`~mx_rcnn_tpu.serve.quarantine.InvalidRequest` (failed
         the admission gate),
         :class:`~mx_rcnn_tpu.serve.quarantine.PoisonRequest` (digest is
@@ -435,6 +485,11 @@ class ServingEngine:
                     f"digest {digest[:12]} is quarantined (query of death)"
                 )
         lane = self._lane_for(model, lane)
+        # streaming admission: validate + register the (stream, frame)
+        # identity BEFORE any path that can resolve the future (cache
+        # hits included), so every resolution goes through the gate in
+        # registration order
+        streamed = self._stream_admit(stream, frame)
         # cascade reroute (ISSUE 18): a request resolving to the
         # flagship family serves the cheap family first; the gate at
         # completion decides escalation.  The LANE above was resolved
@@ -461,7 +516,10 @@ class ServingEngine:
                     digest = request_digest(im)
                 arm_version = self.rollout.arm_for(mid_r, digest)
         cache_key = None
-        if self.response_cache is not None:
+        # masks requests bypass the response cache: keys are image-
+        # content keyed and a (dets, rles) tuple must never collide
+        # with a plain-detections entry for the same bytes
+        if self.response_cache is not None and not masks:
             t0 = time.monotonic()
             if cascade_first:
                 # the final serving of a cascaded digest may be the
@@ -478,7 +536,7 @@ class ServingEngine:
                     )
                     if fhit is not None:
                         return self._cached_future(
-                            fhit, t0, lane, tenant, model
+                            fhit, t0, lane, tenant, model, stream, frame
                         )
             # split serving: the key carries the SERVED arm's version,
             # not the live pointer — two versions serve concurrently
@@ -502,7 +560,9 @@ class ServingEngine:
                     # byte-identical by construction: the stored arrays
                     # ARE what the miss returned (callers treat
                     # detections as immutable)
-                    return self._cached_future(hit, t0, lane, tenant, model)
+                    return self._cached_future(
+                        hit, t0, lane, tenant, model, stream, frame
+                    )
         cap = self.batcher.max_queue
         if self._routed:
             # load shedding: scale the effective intake capacity by the
@@ -516,6 +576,8 @@ class ServingEngine:
                 self.metrics.inc("rejected")
                 if tenant is not None:
                     self.metrics.record_tenant(tenant, shed=True)
+                if streamed:
+                    self._stream_cancel(stream, frame)
                 raise QueueFull(
                     f"shedding load: healthy fraction {frac:.2f}, "
                     f"effective queue capacity {cap if frac else 0}"
@@ -537,6 +599,8 @@ class ServingEngine:
                     self.metrics.inc("shed")
                     self.metrics.inc("rejected")
                     self.metrics.record_tenant(tenant, shed=True)
+                    if streamed:
+                        self._stream_cancel(stream, frame)
                     raise TenantOverBudget(
                         f"shedding tenant {tenant!r}: holds "
                         f"{by_t.get(tenant, 0)}/{pending} queued requests, "
@@ -557,6 +621,10 @@ class ServingEngine:
             req.lane = lane
             req.tenant = tenant
             req.cache_key = cache_key
+            if streamed:
+                req.stream = stream
+                req.frame = int(frame)
+            req.masks = bool(masks)
             if cascade_first:
                 # keep the validated pixels so an escalation can
                 # re-prepare them for the flagship family's config
@@ -575,6 +643,10 @@ class ServingEngine:
             self.batcher.submit(req)
         except Exception:
             self.metrics.inc("rejected")
+            if streamed:
+                # withdraw the registration or the stream deadlocks on
+                # the permanent gap
+                self._stream_cancel(stream, frame)
             raise
         with self._live_lock:
             self._live[id(req)] = req
@@ -593,11 +665,26 @@ class ServingEngine:
         lane: str,
         tenant: Optional[str],
         model: Optional[str],
+        stream: Optional[str] = None,
+        frame: Optional[int] = None,
     ) -> Future:
         """Resolve a response-cache hit: a pre-completed Future plus the
-        same request accounting a recompute would have produced."""
+        same request accounting a recompute would have produced.  A
+        stream-tagged hit still goes through the delivery gate — a
+        cached frame N+1 must not resolve before in-flight frame N."""
         f: Future = Future()
-        f.set_result(hit)
+
+        def fire() -> bool:
+            try:
+                f.set_result(hit)
+                return True
+            except InvalidStateError:
+                return False
+
+        if stream is None:
+            fire()
+        else:
+            self.streams.settle(stream, int(frame), fire)
         self.metrics.inc("submitted")
         self.metrics.inc("completed")
         e2e = time.monotonic() - t0
@@ -630,17 +717,30 @@ class ServingEngine:
                  exc: Optional[BaseException] = None) -> bool:
         """Resolve one request exactly once and retire it from the live
         registry; False when it already resolved elsewhere (e.g. swept
-        by a concurrent ``stop``)."""
+        by a concurrent ``stop``).
+
+        Stream-tagged requests route through the StreamTable gate:
+        delivery (success AND failure — a client never sees frame N+1
+        before learning frame N's fate) waits for every earlier frame
+        of the stream, while cross-stream and untagged resolutions are
+        untouched.  True here means the settlement was ACCEPTED — it
+        fires now or when the stream gap closes, exactly once."""
         with self._live_lock:
             self._live.pop(id(req), None)
-        try:
-            if exc is not None:
-                req.future.set_exception(exc)
-            else:
-                req.future.set_result(result)
-            return True
-        except InvalidStateError:
-            return False
+
+        def fire() -> bool:
+            try:
+                if exc is not None:
+                    req.future.set_exception(exc)
+                else:
+                    req.future.set_result(result)
+                return True
+            except InvalidStateError:
+                return False
+
+        if req.stream is None:
+            return fire()
+        return self.streams.settle(req.stream, req.frame, fire)
 
     def _assemble_loop(self) -> None:
         while True:
@@ -734,9 +834,26 @@ class ServingEngine:
                 )
                 continue
             try:
-                dets = self.runner.detections_for(
-                    out, batch, k, orig_hw=r.orig_hw, **mkw
-                )
+                if r.masks:
+                    # streaming mask serve: canvas-space RLEs from the
+                    # device-paste path (host keeps only RLE encoding);
+                    # result = (cls_dets, rles), paste cost counted
+                    cls_dets, rles = self.runner.mask_rles_for(
+                        out, batch, k, orig_hw=r.orig_hw, **mkw
+                    )
+                    dets = (cls_dets, rles)
+                    lp = getattr(self.runner, "last_paste_ms", None)
+                    if lp is None:
+                        ref = getattr(self.runner, "_ref", None)
+                        lp = getattr(ref, "last_paste_ms", 0.0)
+                        lb = getattr(ref, "last_paste_bytes", 0)
+                    else:
+                        lb = getattr(self.runner, "last_paste_bytes", 0)
+                    self.metrics.record_paste(lp or 0.0, lb or 0)
+                else:
+                    dets = self.runner.detections_for(
+                        out, batch, k, orig_hw=r.orig_hw, **mkw
+                    )
             except Exception as e:  # postprocess bug: fail this request
                 self.metrics.inc("failed")
                 if model is not None:
@@ -752,7 +869,7 @@ class ServingEngine:
                 # under the CHEAP family's cache key; uncertain → the
                 # request re-enters the batcher as a flagship request
                 # and nothing about this pass is cached or resolved.
-                if self.cascade.sufficient(dets):
+                if self.cascade.sufficient(dets[0] if r.masks else dets):
                     self.metrics.inc("first_pass_sufficient")
                 else:
                     self.metrics.inc("escalations")
@@ -934,6 +1051,12 @@ class ServingEngine:
         req2.enqueue_t = req.enqueue_t  # e2e spans both passes
         req2.digest = req.digest
         req2.budget = req.budget
+        # stream identity rides the escalation: the flagship pass
+        # settles the SAME (stream, frame) registration, so in-order
+        # delivery survives the cascade re-entry
+        req2.stream = req.stream
+        req2.frame = req.frame
+        req2.masks = req.masks
         req2.escalated = True
         if self.rollout is not None and self.rollout.active(pol.flagship):
             # a flagship rollout splits escalated traffic too — same
@@ -1017,6 +1140,9 @@ class ServingEngine:
     def snapshot(self) -> Dict:
         out = self.metrics.snapshot(self.runner.compile_cache)
         out["scheduler"] = self.batcher.stats()
+        streams = self.streams.snapshot()
+        if streams["registered"]:
+            out["streams"] = streams
         if self.response_cache is not None:
             out["response_cache"] = self.response_cache.snapshot()
         parity = getattr(self.runner, "parity", None)

@@ -40,6 +40,16 @@ the original serial request/response cadence.  ``det_meta`` carries the
 per-class dtype+shape so :func:`decode_detections` reconstructs arrays
 byte-identical to what an in-process ``submit`` returned.
 
+Optional ``stream`` (non-empty string) + ``frame`` (non-negative int,
+strictly increasing per stream) header fields put the request under the
+engine's per-stream in-order delivery guarantee (ISSUE 20): frames of
+one stream RESOLVE in frame order even when pipelined ids would let
+them complete out of order; naturally they should be paired with
+``id``-pipelining so the stream's frames are in flight together.
+Either field without the other, a wrong type, or a non-monotone frame
+index is an ``invalid_frame`` / ``invalid_request`` reject.  Absent
+both, the legacy independent-image path serves byte-identically.
+
 A header with an ``"op"`` key instead of image fields is an admin
 frame: ``{"op": "ping"}`` (liveness probe) and ``{"op": "snapshot"}``
 (returns the engine + frontend snapshots) — how a fleet gateway
@@ -590,6 +600,25 @@ class Frontend:
             lane=header.get("lane"),
             tenant=header["tenant"],
         )
+        # streaming mode (ISSUE 20): optional stream/frame header fields
+        # put the request under per-stream in-order delivery; absent =
+        # the legacy independent-image path, byte-identical behavior
+        stream = header.get("stream")
+        frame = header.get("frame")
+        if stream is not None or frame is not None:
+            if not isinstance(stream, str) or not stream:
+                self._reject(state, rid, "invalid_frame",
+                             f"'stream' must be a non-empty string, "
+                             f"got {stream!r}")
+                return
+            if not isinstance(frame, int) or isinstance(frame, bool) \
+                    or frame < 0:
+                self._reject(state, rid, "invalid_frame",
+                             f"'frame' must be a non-negative int, "
+                             f"got {frame!r}")
+                return
+            kwargs["stream"] = stream
+            kwargs["frame"] = frame
         if rid is None:
             # serial path: block the connection, respond in order
             try:
@@ -668,7 +697,9 @@ class FrontendClient:
     def request(self, im: np.ndarray, tenant: str,
                 lane: Optional[str] = None,
                 deadline_s: Optional[float] = None,
-                model: Optional[str] = None) -> Dict:
+                model: Optional[str] = None,
+                stream: Optional[str] = None,
+                frame: Optional[int] = None) -> Dict:
         im = np.ascontiguousarray(im)
         dtype_s = {np.dtype(np.uint8): "uint8",
                    np.dtype(np.float32): "float32"}.get(im.dtype)
@@ -683,6 +714,10 @@ class FrontendClient:
             ),
             "dtype": dtype_s, "shape": list(im.shape),
         }
+        if stream is not None:
+            header["stream"] = stream
+        if frame is not None:
+            header["frame"] = frame
         payload = json.dumps(header).encode("utf-8") + b"\n" + im.tobytes()
         self._sock.sendall(_LEN.pack(len(payload)) + payload)
         return self._recv()

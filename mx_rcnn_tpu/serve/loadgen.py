@@ -11,6 +11,12 @@ scheduling; only timings differ.
 Mixed sizes are the point: they exercise every ladder bucket and prove
 (via the runner's CompileCache) that traffic never triggers a compile
 after warmup.
+
+:func:`run_stream_load` is the open-loop streaming counterpart (ISSUE
+20): one client per stream submits frames in order at frame cadence
+without blocking on results, so consecutive frames of one stream are in
+flight together and the engine's per-stream ordering gate — not client
+pacing — is what keeps delivery in order.
 """
 
 from __future__ import annotations
@@ -384,4 +390,181 @@ def run_load(
     if collect:
         report["_results"] = results
         report["_times"] = times
+    return report
+
+
+def stream_arrivals(
+    num_streams: int,
+    frames_per_stream: int,
+    fps: float,
+    stagger_s: float = 0.0,
+    seed: int = 0,
+) -> Dict[Tuple[int, int], float]:
+    """Per-stream frame-cadence arrival offsets (ISSUE 20): frame ``f``
+    of stream ``s`` arrives at ``s*stagger_s + f/fps`` plus a small
+    deterministic jitter (< 20% of the frame period, so cadence order
+    within a stream is never perturbed).  Returns ``{(s, f): offset}``
+    — the open-loop shape of N cameras delivering frames on a clock,
+    which is what makes several frames of one stream be in flight
+    together (the precondition for the ordering guarantee to matter)."""
+    rng = np.random.RandomState(seed)
+    jit = rng.uniform(0.0, 0.2 / fps, (num_streams, frames_per_stream))
+    return {
+        (s, f): s * stagger_s + f / fps + float(jit[s, f])
+        for s in range(num_streams)
+        for f in range(frames_per_stream)
+    }
+
+
+def run_stream_load(
+    engine,
+    num_streams: int = 4,
+    frames_per_stream: int = 16,
+    fps: float = 30.0,
+    sizes: Sequence[Tuple[int, int]] = DEFAULT_SIZES,
+    seed: int = 0,
+    deadline_s: Optional[float] = None,
+    model: Optional[str] = None,
+    masks: bool = False,
+    stagger_s: float = 0.0,
+    collect: bool = False,
+    stream_prefix: str = "cam",
+) -> Dict:
+    """Streaming counterpart of :func:`run_load`: one client thread per
+    stream submits its frames IN ORDER at frame cadence (``fps``),
+    pipelined — it does not block on results, so consecutive frames of
+    one stream are genuinely in flight together and only the engine's
+    per-stream gate (not client pacing) enforces delivery order.
+
+    Traffic is deterministic from ``seed`` alone: stream ``s`` keeps one
+    image size for all its frames (a camera doesn't change resolution
+    mid-stream — frames of a stream share a ladder bucket), and frame
+    pixels derive from ``seed + s*frames + f``, so a faulted run's
+    result bytes are comparable entry-for-entry against an unfaulted
+    one.
+
+    The report carries the ordering evidence: ``completion_order[s]`` =
+    frame indices of stream ``s`` in the order their futures RESOLVED
+    (recorded by done-callbacks against a global sequence counter),
+    ``in_order`` = whether every stream's list is sorted, and
+    ``lost_frames`` = submitted-but-never-resolved count (must be 0).
+    ``collect=True`` stores each frame's resolution under
+    ``report["_results"][(s, f)]`` for byte comparison."""
+    size_rng = np.random.RandomState(seed)
+    stream_sizes = [
+        sizes[size_rng.randint(len(sizes))] for _ in range(num_streams)
+    ]
+    arr = stream_arrivals(num_streams, frames_per_stream, fps,
+                          stagger_s=stagger_s, seed=seed)
+    lock = threading.Lock()
+    seq = [0]
+    completion: Dict[int, list] = {s: [] for s in range(num_streams)}
+    completion_seq: Dict[Tuple[int, int], int] = {}
+    outcomes = {"ok": 0, "deadline": 0, "error": 0, "queue_full": 0,
+                "invalid": 0, "poison": 0, "exhausted": 0, "rejected": 0}
+    results: Dict[Tuple[int, int], Tuple[str, object]] = {}
+    resolved = [0]
+
+    def classify(e: BaseException) -> str:
+        name = type(e).__name__
+        if "InvalidRequest" in name:
+            return "invalid"
+        if "QueueFull" in name:
+            return "queue_full"
+        if "Poison" in name:
+            return "poison"
+        if "Exhausted" in name:
+            return "exhausted"
+        return "deadline" if "Deadline" in name else "error"
+
+    def on_done(s: int, f: int):
+        def cb(fut) -> None:
+            with lock:
+                completion[s].append(f)
+                completion_seq[(s, f)] = seq[0]
+                seq[0] += 1
+                resolved[0] += 1
+                try:
+                    r = fut.result()
+                    outcomes["ok"] += 1
+                    if collect:
+                        results[(s, f)] = ("ok", r)
+                except Exception as e:  # noqa: BLE001 — typed taxonomy
+                    kind = classify(e)
+                    outcomes[kind] += 1
+                    if collect:
+                        results[(s, f)] = (kind, repr(e))
+        return cb
+
+    submitted = [0]
+
+    def stream_client(s: int, t_start: float) -> None:
+        h, w = stream_sizes[s]
+        sid = f"{stream_prefix}{s}"
+        for f in range(frames_per_stream):
+            wait = t_start + arr[(s, f)] - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            im = synthetic_image(s * frames_per_stream + f, h, w, seed)
+            try:
+                fut = engine.submit(
+                    im, deadline_s=deadline_s, model=model,
+                    stream=sid, frame=f, masks=masks,
+                )
+            except Exception as e:  # noqa: BLE001 — synchronous reject
+                # a rejected frame is NOT registered (no gap): later
+                # frames still deliver; count it, keep streaming
+                with lock:
+                    outcomes["rejected"] += 1
+                    if collect:
+                        results[(s, f)] = (classify(e), repr(e))
+                continue
+            with lock:
+                submitted[0] += 1
+            fut.add_done_callback(on_done(s, f))
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=stream_client, args=(s, t0),
+                         name=f"stream-{s}", daemon=True)
+        for s in range(num_streams)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # drain: every submitted frame must resolve (zero lost frames)
+    deadline = time.monotonic() + 300.0
+    while time.monotonic() < deadline:
+        with lock:
+            if resolved[0] >= submitted[0]:
+                break
+        time.sleep(0.005)
+    wall = time.monotonic() - t0
+
+    in_order = all(
+        completion[s] == sorted(completion[s]) for s in range(num_streams)
+    )
+    report = {
+        "streams": num_streams,
+        "frames_per_stream": frames_per_stream,
+        "fps": fps,
+        "seed": seed,
+        "wall_s": round(wall, 4),
+        "frames_per_sec": (
+            round(outcomes["ok"] / wall, 3) if wall else None
+        ),
+        "submitted": submitted[0],
+        "resolved": resolved[0],
+        "lost_frames": submitted[0] - resolved[0],
+        "outcomes": outcomes,
+        "in_order": in_order,
+        "completion_order": {
+            str(s): list(completion[s]) for s in range(num_streams)
+        },
+        "engine": engine.snapshot(),
+    }
+    if collect:
+        report["_results"] = results
+        report["_completion_seq"] = completion_seq
     return report

@@ -157,9 +157,16 @@ class OverlapStats:
       This is the measured counter behind the device-postprocess fetch
       reduction (mask families: selected ``det_masks`` grids instead of
       the raw ``(R, S, S, K)`` stack).
+    * ``paste_ms`` / ``paste_bytes`` (+ ``_by_model``) (ISSUE 20) —
+      host wall spent in the mask paste+RLE stage and the mask payload
+      it consumed (device canvas bytes vs host S×S grid bytes).  These
+      are first-class pool-merged counters alongside ``fetch_bytes``:
+      the measured evidence behind the streaming bench's device-paste
+      host-cost reduction.
 
     All methods are O(1) and lock-protected; ``note_depth`` is called at
-    every window size change, ``note_fetch`` once per ``complete()``.
+    every window size change, ``note_fetch`` once per ``complete()``,
+    ``note_paste`` once per mask_rles_for.
     """
 
     def __init__(self):
@@ -175,6 +182,13 @@ class OverlapStats:
         # per batch attributed to the serving model — pool-merged like
         # fetch_bytes, the counter behind the cascade's cost claim
         self.device_ms_by_model: Dict[str, float] = {}
+        # streaming mask paste (ISSUE 20): host paste+RLE wall and the
+        # mask payload it consumed — pool-merged like fetch_bytes
+        self.pastes = 0
+        self.paste_s = 0.0
+        self.paste_bytes = 0
+        self.paste_ms_by_model: Dict[str, float] = {}
+        self.paste_bytes_by_model: Dict[str, int] = {}
         self._t0: Optional[float] = None   # first dispatch ever
         self._t_last: Optional[float] = None
 
@@ -219,6 +233,26 @@ class OverlapStats:
         with self._lock:
             self.hidden_host_s += max(float(seconds), 0.0)
 
+    def note_paste(
+        self,
+        seconds: float,
+        nbytes: int = 0,
+        model: Optional[str] = None,
+    ) -> None:
+        s = max(float(seconds), 0.0)
+        with self._lock:
+            self.pastes += 1
+            self.paste_s += s
+            key = model if model is not None else "default"
+            self.paste_ms_by_model[key] = (
+                self.paste_ms_by_model.get(key, 0.0) + s * 1e3
+            )
+            if nbytes:
+                self.paste_bytes += int(nbytes)
+                self.paste_bytes_by_model[key] = (
+                    self.paste_bytes_by_model.get(key, 0) + int(nbytes)
+                )
+
     def snapshot(self) -> Dict:
         with self._lock:
             wall = (
@@ -242,6 +276,14 @@ class OverlapStats:
                     k: round(v, 3)
                     for k, v in self.device_ms_by_model.items()
                 },
+                "pastes": self.pastes,
+                "paste_ms": round(self.paste_s * 1e3, 3),
+                "paste_bytes": self.paste_bytes,
+                "paste_ms_by_model": {
+                    k: round(v, 3)
+                    for k, v in self.paste_ms_by_model.items()
+                },
+                "paste_bytes_by_model": dict(self.paste_bytes_by_model),
             }
 
 
@@ -278,6 +320,13 @@ class ServeMetrics:
         self.exhausted = 0     # retry budget spent: RetriesExhausted
         self.resubmitted = 0   # split from an implicated batch, solo retry
         self.exonerated = 0    # suspects cleared by later success
+        # streaming mask paste (ISSUE 20): engine-level mirror of the
+        # replica OverlapStats paste counters — host paste+RLE wall and
+        # mask payload per served mask frame, summed by merge_snapshots
+        # across the fleet gateway like every other numeric leaf
+        self.mask_frames = 0
+        self.paste_ms = 0.0
+        self.paste_bytes = 0
         # batch occupancy: real requests per padded device-batch slot
         self.batches = 0
         self.batch_real = 0
@@ -415,6 +464,13 @@ class ServeMetrics:
             m["batch_real"] += real
             m["batch_slots"] += slots
 
+    def record_paste(self, ms: float, nbytes: int = 0) -> None:
+        """One served mask frame's paste+RLE host wall + payload."""
+        with self._lock:
+            self.mask_frames += 1
+            self.paste_ms += float(ms)
+            self.paste_bytes += int(nbytes)
+
     def record_batch(self, real: int, slots: int) -> None:
         with self._lock:
             self.batches += 1
@@ -469,6 +525,11 @@ class ServeMetrics:
                 "queue": {
                     "depth": self.queue_depth,
                     "depth_max": self.queue_depth_max,
+                },
+                "paste": {
+                    "mask_frames": self.mask_frames,
+                    "paste_ms": round(self.paste_ms, 3),
+                    "paste_bytes": self.paste_bytes,
                 },
             }
         out["latency"] = {

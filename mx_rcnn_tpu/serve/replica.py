@@ -209,6 +209,10 @@ class Replica:
         # are atomic enough for counters; the transition log is locked)
         self.latency = LatencyHistogram()
         self.overlap = OverlapStats()
+        # runner-side paste accounting (mask_rles_for) lands in the same
+        # pool-merged OverlapStats as fetch_bytes
+        if hasattr(self.runner, "overlap"):
+            self.runner.overlap = self.overlap
         self.transitions: List[Dict[str, Any]] = []
         self.dispatches = 0
         self.failures = 0
@@ -734,6 +738,8 @@ class Replica:
                         if bs
                     }
                     self.runner = self._factory(self.index)
+                    if hasattr(self.runner, "overlap"):
+                        self.runner.overlap = self.overlap
                     self.rewarms += 1
                     if served:
                         try:

@@ -233,6 +233,19 @@ class ReplicaPool:
             out, batch, index, orig_hw=orig_hw, thresh=thresh, model=model
         )
 
+    def mask_rles_for(self, out, batch, index, orig_hw=None, thresh=None,
+                      model=None):
+        # host-side decode like detections_for — any runner can serve
+        # it; paste counters land on the reference replica's pool-merged
+        # OverlapStats
+        if model is None:
+            return self._ref.mask_rles_for(
+                out, batch, index, orig_hw=orig_hw, thresh=thresh
+            )
+        return self._ref.mask_rles_for(
+            out, batch, index, orig_hw=orig_hw, thresh=thresh, model=model
+        )
+
     def warmup(self, timeout: float = 300.0) -> int:
         """Block until every replica has warmed its ladder and passed its
         initial probe; returns total compile misses across the pool."""
@@ -635,6 +648,19 @@ class ReplicaPool:
                 ),
                 "device_ms_by_model": _merge_ms_counts(
                     o.get("device_ms_by_model", {}) for o in overlap
+                ),
+                "pastes": sum(o.get("pastes", 0) for o in overlap),
+                "paste_ms": round(
+                    sum(o.get("paste_ms", 0.0) for o in overlap), 3
+                ),
+                "paste_bytes": sum(
+                    o.get("paste_bytes", 0) for o in overlap
+                ),
+                "paste_ms_by_model": _merge_ms_counts(
+                    o.get("paste_ms_by_model", {}) for o in overlap
+                ),
+                "paste_bytes_by_model": _merge_byte_counts(
+                    o.get("paste_bytes_by_model", {}) for o in overlap
                 ),
             },
             "compile": self.compile_cache.snapshot(),

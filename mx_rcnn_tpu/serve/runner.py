@@ -177,6 +177,7 @@ def detections_from_output(
     num_classes: int,
     index: int = 0,
     thresh: Optional[float] = None,
+    with_rows: bool = False,
 ):
     """One image's forward outputs → per-class (n, 5) [x1 y1 x2 y2 score].
 
@@ -192,12 +193,20 @@ def detections_from_output(
     det-grid indices, ops/postprocess.py) — the sigmoid happens here,
     with the exact numpy expression of the reference ``im_detect``, so
     the resulting probabilities are bit-identical to the raw-head path.
+
+    ``with_rows=True`` additionally returns, as a third element, the
+    per-class det-grid row indices each kept detection came from (device
+    path only; None on the host path) — the alignment the streaming
+    canvas path (:meth:`ServeRunner.mask_rles_for`) needs to map capped
+    detections back onto their ``det_canvas`` / ``det_masks`` slots.
     """
     te = cfg.TEST
     thresh = te.SCORE_THRESH if thresh is None else thresh
     cls_dets: ClsDets = [None] * num_classes
     mask_probs: Optional[Dict[int, np.ndarray]] = None
+    det_rows: Optional[Dict[int, np.ndarray]] = None
     if "det_boxes" in out:
+        det_rows = {}
         lut = None
         if "det_masks" in out:
             mask_probs = {}
@@ -210,8 +219,9 @@ def detections_from_output(
             b = np.asarray(out["det_boxes"][index][j - 1][m])
             s = np.asarray(out["det_scores"][index][j - 1][m])
             cls_dets[j] = np.hstack([b, s[:, None]]).astype(np.float32)
+            det_rows[j] = np.where(m)[0]
             if lut is not None:
-                rows = np.where(m)[0]
+                rows = det_rows[j]
                 # rows beyond the device's max_det mask budget only
                 # exist past the MAX_PER_IMAGE cut — cap_detections
                 # drops them; the large-negative logit fill (sigmoid ≈ 0
@@ -239,6 +249,8 @@ def detections_from_output(
             cls_dets[j] = cd[keep_nms]
             if mask_probs is not None:
                 mask_probs[j] = det["mask_probs"][keep][keep_nms, :, :, j]
+    if with_rows:
+        return cls_dets, mask_probs, det_rows
     return cls_dets, mask_probs
 
 
@@ -246,10 +258,14 @@ def cap_detections(
     cls_dets: ClsDets,
     max_per_image: int,
     mask_probs: Optional[Dict[int, np.ndarray]] = None,
+    rows: Optional[Dict[int, np.ndarray]] = None,
 ):
     """Cross-class per-image detection cap (COCO-style, reference
     ``max_per_image``): keep the globally top-scoring ``max_per_image``
-    detections across classes.  No-op when ``max_per_image <= 0``."""
+    detections across classes.  No-op when ``max_per_image <= 0``.
+    ``rows`` (the ``with_rows`` side-channel of
+    :func:`detections_from_output`) is filtered in lockstep and returned
+    as a third element when given."""
     num_classes = len(cls_dets)
     if max_per_image > 0:
         all_scores = np.concatenate(
@@ -262,6 +278,10 @@ def cap_detections(
                 cls_dets[j] = cls_dets[j][keep]
                 if mask_probs is not None:
                     mask_probs[j] = mask_probs[j][keep]
+                if rows is not None and rows.get(j) is not None:
+                    rows[j] = rows[j][keep]
+    if rows is not None:
+        return cls_dets, mask_probs, rows
     return cls_dets, mask_probs
 
 
@@ -385,6 +405,7 @@ class ServeRunner:
         layout_feed: Optional[bool] = None,
         registry=None,
         device=None,
+        mask_canvas: Optional[bool] = None,
         precision: Optional[Union[str, Dict[str, str]]] = None,
         parity_check: bool = True,
         parity_box_tol: float = 4.0,
@@ -473,6 +494,23 @@ class ServeRunner:
         self.device_ms_total = 0.0
         self.device_ms_by_model: Dict[str, float] = {}
         self.last_device_ms = 0.0
+        # mask canvas paste (ISSUE 20): None defers to each model cfg's
+        # TEST.MASK_CANVAS; True/False overrides for every mask family
+        self._mask_canvas = mask_canvas
+        # paste accounting (ISSUE 20): host wall ms and mask payload
+        # bytes consumed by the paste+RLE stage (mask_rles_for) — the
+        # streaming bench's host-paste-reduction evidence, per model and
+        # in total.  ``overlap`` is the owning Replica's OverlapStats
+        # hook (set by Replica.__init__/_recover) so the same numbers
+        # pool-merge through the router snapshot alongside fetch_bytes.
+        self.pastes = 0
+        self.paste_ms_total = 0.0
+        self.paste_bytes_total = 0
+        self.paste_ms_by_model: Dict[str, float] = {}
+        self.paste_bytes_by_model: Dict[str, int] = {}
+        self.last_paste_ms = 0.0
+        self.last_paste_bytes = 0
+        self.overlap = None
         # build the default slot eagerly: construction fails fast on a
         # bad config, and legacy callers read .predictor immediately
         self._slot(self.default_model)
@@ -557,9 +595,15 @@ class ServeRunner:
             if use_post:
                 from mx_rcnn_tpu.ops.postprocess import make_test_postprocess
 
+                use_canvas = (
+                    getattr(cfg.TEST, "MASK_CANVAS", False)
+                    if self._mask_canvas is None
+                    else self._mask_canvas
+                )
                 post = make_test_postprocess(
                     cfg, n_cls, cfg.TEST.SCORE_THRESH,
                     max_out=cfg.TEST.DET_PER_CLASS,
+                    paste=bool(use_canvas and cfg.network.USE_MASK),
                 )
             # deterministic: shape-independent reduction order on CPU,
             # making cross-bucket detections bitwise identical (Predictor
@@ -894,6 +938,7 @@ class ServeRunner:
             post = make_test_postprocess(
                 e.cfg, slot.num_classes, e.cfg.TEST.SCORE_THRESH,
                 max_out=e.cfg.TEST.DET_PER_CLASS,
+                paste="det_canvas" in out_rp,
             )
         ref_predictor = Predictor(
             e.model, self._place(live.params), postprocess=post,
@@ -1079,6 +1124,106 @@ class ServeRunner:
         if with_masks:
             return cls_dets, mask_probs
         return cls_dets
+
+    def mask_rles_for(
+        self,
+        out: Dict[str, np.ndarray],
+        batch: Dict[str, np.ndarray],
+        index: int,
+        orig_hw: Optional[Tuple[float, float]] = None,
+        thresh: Optional[float] = None,
+        model: Optional[str] = None,
+    ):
+        """Per-image capped detections + CANVAS-space mask RLEs — the
+        streaming mask serve path.  Returns ``(cls_dets, rles)`` with
+        ``rles[j]`` aligned row-for-row with ``cls_dets[j]``; RLEs are
+        in the fixed (bucket-extent) canvas the image was padded to.
+
+        Two paths, identical bytes by construction:
+
+        * device canvas (``det_canvas`` in ``out``, paste ran in the
+          jit): the host keeps only RLE encoding;
+        * host paste (device postprocess without paste): each
+          survivor's fetched LOGIT grid goes through the numpy
+          fixed-point mirror (``eval/segm.py::paste_mask_canvas``).
+
+        Accounts ``paste_ms`` (host wall in the paste+RLE stage) and
+        ``paste_bytes`` (mask payload consumed: canvas bytes vs grid
+        bytes) per model, and mirrors both into the owning replica's
+        :class:`~mx_rcnn_tpu.serve.metrics.OverlapStats` when attached
+        — the pool-merged evidence behind the streaming bench's
+        host-paste-reduction claim."""
+        from mx_rcnn_tpu.eval.segm import canvas_rles
+        from mx_rcnn_tpu.native import rle as rle_mod
+
+        if "det_masks" not in out:
+            raise ValueError(
+                "mask_rles_for needs the fused device-postprocess mask "
+                "outputs (det_masks); raw-head batches have no canvas "
+                "contract"
+            )
+        mid = self.default_model if model is None else model
+        slot = self._slot(mid)
+        if orig_hw is None:
+            orig_hw = tuple(batch["orig_hw"][index])
+        cls_dets, _probs, rows = detections_from_output(
+            out, batch["im_info"][index], orig_hw, slot.cfg,
+            slot.num_classes, index=index, thresh=thresh, with_rows=True,
+        )
+        cls_dets, _probs, rows = cap_detections(
+            cls_dets, slot.cfg.TEST.MAX_PER_IMAGE, _probs, rows=rows
+        )
+        midx = np.asarray(out["det_mask_idx"][index])
+        lut = {int(f): p for p, f in enumerate(midx) if f >= 0}
+        max_out_dim = out["det_boxes"].shape[2]
+        hc = int(batch["images"].shape[1])
+        wc = int(batch["images"].shape[2])
+        scale = float(batch["im_info"][index][2])
+        canvas = out.get("det_canvas")
+        rles: Dict[int, list] = {}
+        t0 = time.monotonic()
+        if canvas is not None:
+            cv = np.asarray(canvas[index])
+            nbytes = int(cv.nbytes)
+            empty = np.zeros((hc, wc), np.uint8)
+            for j in range(1, slot.num_classes):
+                out_j = []
+                for rr in rows[j]:
+                    p = lut.get((j - 1) * max_out_dim + int(rr))
+                    # an unmapped row only exists past the device's
+                    # max_det budget; its device canvas would have been
+                    # all zeros too (the -80-logit fill story)
+                    out_j.append(rle_mod.encode(
+                        np.ascontiguousarray(cv[p]) if p is not None
+                        else empty
+                    ))
+                rles[j] = out_j
+        else:
+            grids_all = np.asarray(out["det_masks"][index])
+            nbytes = int(grids_all.nbytes)
+            fill = np.full(grids_all.shape[1:], -80.0, np.float32)
+            for j in range(1, slot.num_classes):
+                grids = [
+                    grids_all[lut[(j - 1) * max_out_dim + int(rr)]]
+                    if (j - 1) * max_out_dim + int(rr) in lut else fill
+                    for rr in rows[j]
+                ]
+                rles[j] = canvas_rles(grids, cls_dets[j], scale, hc, wc)
+        dt = time.monotonic() - t0
+        self.pastes += 1
+        self.last_paste_ms = dt * 1000.0
+        self.last_paste_bytes = nbytes
+        self.paste_ms_total += dt * 1000.0
+        self.paste_bytes_total += nbytes
+        self.paste_ms_by_model[mid] = (
+            self.paste_ms_by_model.get(mid, 0.0) + dt * 1000.0
+        )
+        self.paste_bytes_by_model[mid] = (
+            self.paste_bytes_by_model.get(mid, 0) + nbytes
+        )
+        if self.overlap is not None:
+            self.overlap.note_paste(dt, nbytes=nbytes, model=mid)
+        return cls_dets, rles
 
     # ---- synchronous single image (demo path)
     def detect(self, im: np.ndarray, thresh: Optional[float] = None) -> ClsDets:

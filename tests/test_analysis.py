@@ -570,6 +570,118 @@ def test_r5_silent_on_requeued_drain_dispatch():
                     path="mx_rcnn_tpu/serve/autoscaler.py") == []
 
 
+# R4 against the ISSUE 20 streaming gate: the engine resolves a request
+# under Engine._lock and calls StreamTable.settle (a leaf); a table
+# that fires the settlement callback while still HOLDING
+# StreamTable._lock calls back into the engine and closes the cycle.
+# The drainer discipline (collect the ready run under the lock, fire
+# after release) is the shipped one-way design.
+
+R4_STREAMS_BAD = """
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+
+class Engine:
+    def __init__(self):
+        self._lock = make_lock("Engine._lock")
+        self.streams = None
+
+    def resolve(self, req):
+        with self._lock:
+            return self.streams.settle(req)
+
+class StreamTable:
+    def __init__(self):
+        self._lock = make_lock("StreamTable._lock")
+        self.engine = None
+
+    def settle(self, req):
+        with self._lock:
+            return self.engine.resolve(req)
+"""
+
+R4_STREAMS_GOOD = """
+from mx_rcnn_tpu.analysis.lockcheck import make_lock
+
+class Engine:
+    def __init__(self):
+        self._lock = make_lock("Engine._lock")
+        self.streams = None
+
+    def resolve(self, req):
+        with self._lock:
+            return self.streams.settle(req)
+
+class StreamTable:
+    def __init__(self):
+        self._lock = make_lock("StreamTable._lock")
+
+    def settle(self, req):
+        with self._lock:
+            run = [req]
+        for fire in run:
+            fire()
+        return True
+"""
+
+
+def test_r4_fires_on_stream_settle_cycle():
+    fs = run_rule(R4_STREAMS_BAD, LockOrder(),
+                  path="mx_rcnn_tpu/serve/streams.py")
+    assert any("cycle" in f.message for f in fs)
+
+
+def test_r4_silent_on_stream_drainer_discipline():
+    assert run_rule(R4_STREAMS_GOOD, LockOrder(),
+                    path="mx_rcnn_tpu/serve/streams.py") == []
+
+
+# R5 against the ISSUE 20 in-order buffer: a parked settlement callback
+# popped off the buffer and then dropped on a shutdown flag is a frame
+# the client never hears about — the stream's successors are wedged
+# behind the gap forever.  The shipped flush() drains every taken
+# callback (sentinel break + resolve-all drain).
+
+R5_STREAMS_BAD = """
+class StreamTable:
+    def flush(self):
+        while True:
+            fire = self._pending.get(timeout=0.02)
+            if self._closed:
+                return
+            fire.resolve(None)
+"""
+
+R5_STREAMS_GOOD = """
+class StreamTable:
+    def loop(self):
+        while True:
+            fire = self._pending.get(timeout=0.02)
+            if fire is None:
+                break
+            self._fire(fire)
+
+    def flush(self):
+        while True:
+            try:
+                fire = self._pending.get_nowait()
+            except Exception:
+                break
+            if fire is not None:
+                fire.resolve(None)
+"""
+
+
+def test_r5_fires_on_dropped_buffered_settlement():
+    fs = run_rule(R5_STREAMS_BAD, ExactlyOnce(),
+                  path="mx_rcnn_tpu/serve/streams.py")
+    assert len(fs) == 1 and "`fire`" in fs[0].message
+
+
+def test_r5_silent_on_stream_flush_drain():
+    assert run_rule(R5_STREAMS_GOOD, ExactlyOnce(),
+                    path="mx_rcnn_tpu/serve/streams.py") == []
+
+
 # ---------------------------------------------------------------- R6
 
 R6_FAULTS = """

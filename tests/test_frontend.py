@@ -409,16 +409,53 @@ class TestFrontendWire:
         good_header() + b"\x00" * 5,  # byte count != 2*2*3
         good_header(shape=[2, -1, 3]) + b"\x00" * 12,
         good_header(shape="2x2x3") + b"\x00" * 12,
+        # streaming header fields (ISSUE 20): stream/frame must be a
+        # non-empty string + non-negative int, always together
+        good_header(stream="") + b"\x00" * 12,
+        good_header(stream=7, frame=0) + b"\x00" * 12,
+        good_header(stream="cam0") + b"\x00" * 12,
+        good_header(frame=0) + b"\x00" * 12,
+        good_header(stream="cam0", frame=-1) + b"\x00" * 12,
+        good_header(stream="cam0", frame="0") + b"\x00" * 12,
+        good_header(stream="cam0", frame=True) + b"\x00" * 12,
     ], ids=["no-newline", "bad-json", "non-dict", "tenant-null",
             "tenant-empty", "tenant-nonstring", "bad-dtype", "shape-2d",
             "shape-not-rgb", "shape-zero", "byte-mismatch",
-            "shape-negative", "shape-nonlist"])
+            "shape-negative", "shape-nonlist", "stream-empty",
+            "stream-nonstring", "stream-no-frame", "frame-no-stream",
+            "frame-negative", "frame-nonint", "frame-bool"])
     def test_malformed_frame_matrix(self, served_engine, payload):
         _, fe = served_engine
         with FrontendClient("127.0.0.1", fe.port) as cli:
             resp = cli.send_raw(payload)
         assert resp["ok"] is False
         assert resp["error"] == "invalid_frame"
+
+    def test_streaming_headers_round_trip_and_order_gate(
+            self, served_engine):
+        """Valid ``stream``/``frame`` headers ride the wire into the
+        engine's per-stream gate; a non-monotone frame index comes back
+        as a typed ``invalid_request`` (engine admission), not an
+        ``invalid_frame`` (wire shape) error."""
+        engine, fe = served_engine
+        with FrontendClient("127.0.0.1", fe.port) as cli:
+            r0 = cli.request(image(1), tenant="acme", stream="cam0",
+                             frame=0)
+            r1 = cli.request(image(2), tenant="acme", stream="cam0",
+                             frame=1)
+            assert r0["ok"] and r1["ok"]
+            # frame 1 again: monotone register rule → engine admission
+            dup = cli.request(image(3), tenant="acme", stream="cam0",
+                              frame=1)
+            assert dup["ok"] is False
+            assert dup["error"] == "invalid_request"
+            # another stream is independent: frame 0 is fine there
+            r2 = cli.request(image(4), tenant="acme", stream="cam1",
+                             frame=0)
+            assert r2["ok"]
+        snap = engine.snapshot()["streams"]
+        assert snap["registered"] == 3
+        assert snap["delivered"] == 3
 
     def test_malformed_frames_count_and_connection_survives(
             self, served_engine):
